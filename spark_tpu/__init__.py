@@ -1,0 +1,16 @@
+"""spark_tpu — a TPU-native distributed data-analytics engine with the
+capabilities of Apache Spark, built from scratch on JAX/XLA/Pallas/pjit.
+
+See SURVEY.md at the repo root for the structural analysis of the
+reference (Apache Spark 3.5.0-SNAPSHOT) this is built to match.
+"""
+
+__version__ = "0.1.0"
+
+
+def _require_x64():
+    """The SQL engine needs int64/float64; enable x64 once, lazily."""
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)

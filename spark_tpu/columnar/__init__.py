@@ -1,0 +1,20 @@
+from spark_tpu.columnar.batch import (
+    Batch,
+    BatchData,
+    ColumnData,
+    empty_batch,
+    from_numpy,
+    round_capacity,
+)
+from spark_tpu.columnar.arrow import from_arrow, to_arrow
+
+__all__ = [
+    "Batch",
+    "BatchData",
+    "ColumnData",
+    "empty_batch",
+    "from_numpy",
+    "from_arrow",
+    "to_arrow",
+    "round_capacity",
+]

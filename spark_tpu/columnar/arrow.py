@@ -1,0 +1,165 @@
+"""Arrow <-> device-batch interchange.
+
+The TPU analogue of the reference's Arrow surface
+(reference: sql/core/.../execution/arrow/ArrowConverters.scala:188,313 and
+ArrowColumnVector.java): Arrow record batches are the ingestion format
+from Parquet/CSV readers and external clients, and the hand-off point to
+device memory.
+
+Strings are dictionary-encoded with pyarrow on the host (the analogue of
+the reference leaning on UTF8String everywhere is *not* wanted on TPU:
+all device-side string ops happen on int32 codes, and per-dictionary
+lookup tables are built host-side at trace time).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from spark_tpu import types as T
+from spark_tpu.columnar.batch import Batch, from_numpy
+from spark_tpu.types import Field, Schema
+
+
+def arrow_type_to_dtype(at: pa.DataType) -> T.DataType:
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_int8(at):
+        return T.INT8
+    if pa.types.is_int16(at):
+        return T.INT16
+    if pa.types.is_int32(at):
+        return T.INT32
+    if pa.types.is_int64(at):
+        return T.INT64
+    if pa.types.is_float32(at):
+        return T.FLOAT32
+    if pa.types.is_float64(at):
+        return T.FLOAT64
+    if pa.types.is_decimal(at):
+        return T.DecimalType(at.precision, at.scale)
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.STRING
+    if pa.types.is_date32(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_dictionary(at):
+        return arrow_type_to_dtype(at.value_type)
+    raise TypeError(f"unsupported arrow type: {at}")
+
+
+def dtype_to_arrow_type(dt: T.DataType) -> pa.DataType:
+    if isinstance(dt, T.BooleanType):
+        return pa.bool_()
+    if isinstance(dt, T.Int8Type):
+        return pa.int8()
+    if isinstance(dt, T.Int16Type):
+        return pa.int16()
+    if isinstance(dt, T.Int32Type):
+        return pa.int32()
+    if isinstance(dt, T.Int64Type):
+        return pa.int64()
+    if isinstance(dt, T.Float32Type):
+        return pa.float32()
+    if isinstance(dt, T.Float64Type):
+        return pa.float64()
+    if isinstance(dt, T.DecimalType):
+        return pa.float64()
+    if isinstance(dt, T.StringType):
+        return pa.string()
+    if isinstance(dt, T.DateType):
+        return pa.date32()
+    if isinstance(dt, T.TimestampType):
+        return pa.timestamp("us")
+    raise TypeError(f"unsupported dtype: {dt}")
+
+
+def _column_to_numpy(
+    arr: pa.ChunkedArray, dtype: T.DataType
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[Tuple[str, ...]]]:
+    """Convert one Arrow column to (values, validity, dictionary)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+
+    validity: Optional[np.ndarray] = None
+    if arr.null_count > 0:
+        validity = pc.is_valid(arr).to_numpy(zero_copy_only=False)
+
+    dictionary: Optional[Tuple[str, ...]] = None
+    if isinstance(dtype, T.StringType):
+        if not pa.types.is_dictionary(arr.type):
+            arr = pc.dictionary_encode(arr)
+        dictionary = tuple(arr.dictionary.to_pylist())
+        codes = pc.fill_null(arr.indices, 0).to_numpy(zero_copy_only=False)
+        values = np.ascontiguousarray(codes, dtype=np.int32)
+        if validity is not None:
+            values = np.where(validity, values, 0).astype(np.int32)
+        return values, validity, dictionary
+
+    if isinstance(dtype, T.DecimalType):
+        arr = arr.cast(pa.float64())
+    if isinstance(dtype, T.DateType):
+        arr = arr.cast(pa.int32())
+    if isinstance(dtype, T.TimestampType):
+        arr = arr.cast(pa.timestamp("us")).cast(pa.int64())
+    if isinstance(dtype, T.BooleanType):
+        values = arr.to_numpy(zero_copy_only=False).astype(np.bool_)
+    else:
+        values = arr.to_numpy(zero_copy_only=False)
+    values = np.asarray(values)
+    if validity is not None:
+        # Arrow may hand us an object/NaN-filled array for nullable cols.
+        fill = np.zeros((), dtype=dtype.np_dtype)
+        values = np.where(validity, values, fill)
+    return values.astype(dtype.np_dtype, copy=False), validity, dictionary
+
+
+def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> Batch:
+    """Arrow table -> device Batch (pads to bucketed capacity)."""
+    fields = []
+    arrays = []
+    validities = []
+    for name, col in zip(table.column_names, table.columns):
+        dtype = arrow_type_to_dtype(col.type)
+        values, validity, dictionary = _column_to_numpy(col, dtype)
+        fields.append(Field(name, dtype, nullable=validity is not None,
+                            dictionary=dictionary))
+        arrays.append(values)
+        validities.append(validity)
+    schema = Schema(tuple(fields))
+    return from_numpy(schema, arrays, validities, capacity=capacity)
+
+
+def to_arrow(batch: Batch) -> pa.Table:
+    """Device Batch -> Arrow table with only live rows."""
+    mask = np.asarray(batch.data.row_mask)
+    columns = []
+    names = []
+    for f, cd in zip(batch.schema.fields, batch.data.columns):
+        data = np.asarray(cd.data)[mask]
+        valid = None if cd.validity is None else np.asarray(cd.validity)[mask]
+        if isinstance(f.dtype, T.StringType):
+            dictionary = list(f.dictionary or ())
+            codes = pa.array(data, type=pa.int32(),
+                             mask=None if valid is None else ~valid)
+            arr = pa.DictionaryArray.from_arrays(
+                codes, pa.array(dictionary, type=pa.string())
+            ).cast(pa.string())
+        elif isinstance(f.dtype, T.DateType):
+            arr = pa.array(data, type=pa.int32(),
+                           mask=None if valid is None else ~valid).cast(pa.date32())
+        elif isinstance(f.dtype, T.TimestampType):
+            arr = pa.array(data, type=pa.int64(),
+                           mask=None if valid is None else ~valid).cast(
+                pa.timestamp("us"))
+        else:
+            arr = pa.array(data, type=dtype_to_arrow_type(f.dtype),
+                           mask=None if valid is None else ~valid)
+        columns.append(arr)
+        names.append(f.name)
+    return pa.table(columns, names=names)

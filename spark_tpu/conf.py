@@ -1,0 +1,85 @@
+"""Typed configuration registry.
+
+Analogue of the reference's ConfigEntry system (reference:
+core/src/main/scala/org/apache/spark/internal/config/ConfigEntry.scala:74
+and sql/catalyst/.../internal/SQLConf.scala:56) — typed entries with
+defaults, docs, and session-local overrides — minus the JVM machinery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    key: str
+    default: Any
+    doc: str
+    value_type: Callable[[Any], Any] = lambda x: x
+
+
+_REGISTRY: Dict[str, ConfigEntry] = {}
+
+
+def register(key: str, default: Any, doc: str,
+             value_type: Callable[[Any], Any] = lambda x: x) -> ConfigEntry:
+    entry = ConfigEntry(key, default, doc, value_type)
+    _REGISTRY[key] = entry
+    return entry
+
+
+# ---- core entries ----------------------------------------------------------
+
+SHUFFLE_PARTITIONS = register(
+    "spark.sql.shuffle.partitions", 0,
+    "Number of partitions for exchanges; 0 = one per mesh device "
+    "(reference default 200: SQLConf.scala:614).", int)
+
+BATCH_CAPACITY_MULTIPLE = register(
+    "spark.tpu.batch.capacityMultiple", 1024,
+    "Row capacities are rounded up to a multiple of this so jit caches "
+    "hit across similar-sized inputs.", int)
+
+BROADCAST_THRESHOLD = register(
+    "spark.sql.autoBroadcastJoinThreshold", 8 * 1024 * 1024,
+    "Max estimated build-side bytes for broadcast hash join "
+    "(reference: SQLConf AUTO_BROADCASTJOIN_THRESHOLD).", int)
+
+CASE_SENSITIVE = register(
+    "spark.sql.caseSensitive", False,
+    "Whether identifiers are case sensitive (reference: SQLConf.scala).", bool)
+
+REPARTITION_SLACK = register(
+    "spark.tpu.exchange.slackFactor", 4,
+    "Per-destination capacity slack factor for hash repartition "
+    "(all_to_all requires static per-pair sizes).", int)
+
+
+class RuntimeConf:
+    """Session-scoped mutable view over the registry."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._overrides: Dict[str, Any] = dict(overrides or {})
+
+    def get(self, entry_or_key) -> Any:
+        key = entry_or_key.key if isinstance(entry_or_key, ConfigEntry) else entry_or_key
+        if key in self._overrides:
+            return self._overrides[key]
+        if key in _REGISTRY:
+            return _REGISTRY[key].default
+        raise KeyError(f"unknown config key: {key}")
+
+    def set(self, key: str, value: Any) -> None:
+        if key in _REGISTRY:
+            value = _REGISTRY[key].value_type(value)
+        self._overrides[key] = value
+
+    def unset(self, key: str) -> None:
+        self._overrides.pop(key, None)
+
+    def entries(self) -> Dict[str, Any]:
+        out = {k: e.default for k, e in _REGISTRY.items()}
+        out.update(self._overrides)
+        return out

@@ -1,0 +1,4 @@
+from spark_tpu.expr import expressions
+from spark_tpu.expr.compiler import TV, Env, evaluate
+
+__all__ = ["expressions", "TV", "Env", "evaluate"]

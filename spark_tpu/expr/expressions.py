@@ -1,0 +1,767 @@
+"""Expression tree (IR).
+
+The analogue of Catalyst's expression nodes (reference:
+sql/catalyst/.../expressions/Expression.scala and the ~600 expression
+classes under expressions/). Two big simplifications relative to the
+reference:
+
+- there is no interpreted-vs-codegen duality: expressions compile to jax
+  ops (expr/compiler.py) and XLA plays the role Janino played
+  (reference: expressions/codegen/CodeGenerator.scala:1345),
+- nulls are (values, validity-mask) pairs, not boxed values.
+
+Nodes are immutable; ``data_type(schema)`` resolves the output type
+against an input schema (the analyzer's type-resolution role,
+reference: analysis/Analyzer.scala:188).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+from spark_tpu import types as T
+from spark_tpu.types import DataType, Schema
+
+
+class Expression:
+    """Base class. Subclasses are frozen dataclasses."""
+
+    def children(self) -> Tuple["Expression", ...]:
+        return ()
+
+    def data_type(self, schema: Schema) -> DataType:
+        raise NotImplementedError
+
+    def nullable(self, schema: Schema) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        """Output column name when this expression is projected."""
+        return str(self)
+
+    def references(self) -> set:
+        refs = set()
+        for c in self.children():
+            refs |= c.references()
+        return refs
+
+    # -- convenience builders (mirrors the Column DSL) --------------------
+    def __add__(self, other):
+        return Arith("+", self, lit_or_expr(other))
+
+    def __radd__(self, other):
+        return Arith("+", lit_or_expr(other), self)
+
+    def __sub__(self, other):
+        return Arith("-", self, lit_or_expr(other))
+
+    def __rsub__(self, other):
+        return Arith("-", lit_or_expr(other), self)
+
+    def __mul__(self, other):
+        return Arith("*", self, lit_or_expr(other))
+
+    def __rmul__(self, other):
+        return Arith("*", lit_or_expr(other), self)
+
+    def __truediv__(self, other):
+        return Arith("/", self, lit_or_expr(other))
+
+    def __mod__(self, other):
+        return Arith("%", self, lit_or_expr(other))
+
+    def __neg__(self):
+        return Neg(self)
+
+    def __eq__(self, other):  # type: ignore[override]
+        return Cmp("==", self, lit_or_expr(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return Cmp("!=", self, lit_or_expr(other))
+
+    def __lt__(self, other):
+        return Cmp("<", self, lit_or_expr(other))
+
+    def __le__(self, other):
+        return Cmp("<=", self, lit_or_expr(other))
+
+    def __gt__(self, other):
+        return Cmp(">", self, lit_or_expr(other))
+
+    def __ge__(self, other):
+        return Cmp(">=", self, lit_or_expr(other))
+
+    def __and__(self, other):
+        return And(self, lit_or_expr(other))
+
+    def __or__(self, other):
+        return Or(self, lit_or_expr(other))
+
+    def __invert__(self):
+        return Not(self)
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, dtype: DataType) -> "Cast":
+        return Cast(self, dtype)
+
+    def is_null(self) -> "IsNull":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Not":
+        return Not(IsNull(self))
+
+    def isin(self, *values) -> "In":
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        return In(self, tuple(values))
+
+    def between(self, lo, hi) -> "And":
+        return And(Cmp(">=", self, lit_or_expr(lo)),
+                   Cmp("<=", self, lit_or_expr(hi)))
+
+    def like(self, pattern: str) -> "Like":
+        return Like(self, pattern)
+
+    def asc(self) -> "SortOrder":
+        return SortOrder(self, ascending=True)
+
+    def desc(self) -> "SortOrder":
+        return SortOrder(self, ascending=False)
+
+    def semantic_eq(self, other: "Expression") -> bool:
+        return expr_key(self) == expr_key(other)
+
+
+def lit_or_expr(v: Any) -> Expression:
+    return v if isinstance(v, Expression) else Literal(v)
+
+
+def expr_key(e: Expression):
+    """Structural identity key (dataclass __eq__ is hijacked by the SQL
+    `==` DSL, so semantic comparison goes through this)."""
+    if isinstance(e, Literal):
+        return ("lit", e.value, repr(e.dtype))
+    parts = [type(e).__name__]
+    for f_name, f_val in vars(e).items():
+        if isinstance(f_val, Expression):
+            parts.append(expr_key(f_val))
+        elif isinstance(f_val, tuple):
+            parts.append(tuple(
+                expr_key(x) if isinstance(x, Expression) else x for x in f_val))
+        else:
+            parts.append(repr(f_val))
+    return tuple(parts)
+
+
+@dataclass(eq=False, frozen=True)
+class Literal(Expression):
+    value: Any
+    dtype: DataType = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.dtype is None:
+            object.__setattr__(self, "dtype", T.infer_type(self.value))
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.dtype
+
+    def nullable(self, schema: Schema) -> bool:
+        return self.value is None
+
+    @property
+    def name(self) -> str:
+        return str(self.value)
+
+    def __str__(self):
+        return repr(self.value)
+
+
+@dataclass(eq=False, frozen=True)
+class Col(Expression):
+    col_name: str
+
+    def data_type(self, schema: Schema) -> DataType:
+        return schema.field(self.col_name).dtype
+
+    def nullable(self, schema: Schema) -> bool:
+        return schema.field(self.col_name).nullable
+
+    def references(self) -> set:
+        return {self.col_name}
+
+    @property
+    def name(self) -> str:
+        return self.col_name
+
+    def __str__(self):
+        return self.col_name
+
+
+@dataclass(eq=False, frozen=True)
+class Alias(Expression):
+    child: Expression
+    alias_name: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.child.data_type(schema)
+
+    def nullable(self, schema: Schema) -> bool:
+        return self.child.nullable(schema)
+
+    @property
+    def name(self) -> str:
+        return self.alias_name
+
+    def __str__(self):
+        return f"{self.child} AS {self.alias_name}"
+
+
+@dataclass(eq=False, frozen=True)
+class Arith(Expression):
+    op: str  # + - * / %
+    left: Expression
+    right: Expression
+
+    def children(self):
+        return (self.left, self.right)
+
+    def data_type(self, schema: Schema) -> DataType:
+        lt = self.left.data_type(schema)
+        rt = self.right.data_type(schema)
+        # date +/- days
+        if isinstance(lt, T.DateType) and rt.is_integral and self.op in ("+", "-"):
+            return T.DATE
+        if isinstance(rt, T.DateType) and lt.is_integral and self.op == "+":
+            return T.DATE
+        if isinstance(lt, T.DateType) and isinstance(rt, T.DateType) and self.op == "-":
+            return T.INT32
+        out = T.common_type(lt, rt)
+        if self.op == "/" and out.is_integral:
+            return T.FLOAT64  # SQL: integer / -> double (non-ANSI Spark)
+        return out
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(eq=False, frozen=True)
+class Neg(Expression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def __str__(self):
+        return f"(- {self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class Cmp(Expression):
+    op: str  # == != < <= > >=
+    left: Expression
+    right: Expression
+
+    def children(self):
+        return (self.left, self.right)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(eq=False, frozen=True)
+class And(Expression):
+    left: Expression
+    right: Expression
+
+    def children(self):
+        return (self.left, self.right)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"({self.left} AND {self.right})"
+
+
+@dataclass(eq=False, frozen=True)
+class Or(Expression):
+    left: Expression
+    right: Expression
+
+    def children(self):
+        return (self.left, self.right)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"({self.left} OR {self.right})"
+
+
+@dataclass(eq=False, frozen=True)
+class Not(Expression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"(NOT {self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class IsNull(Expression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def nullable(self, schema):
+        return False
+
+    def __str__(self):
+        return f"({self.child} IS NULL)"
+
+
+@dataclass(eq=False, frozen=True)
+class In(Expression):
+    child: Expression
+    values: Tuple[Any, ...]  # python literals
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"({self.child} IN {self.values})"
+
+
+@dataclass(eq=False, frozen=True)
+class Like(Expression):
+    """SQL LIKE with % and _ wildcards; evaluated host-side over the
+    column dictionary, gathered on device by code."""
+
+    child: Expression
+    pattern: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"({self.child} LIKE {self.pattern!r})"
+
+
+@dataclass(eq=False, frozen=True)
+class Cast(Expression):
+    child: Expression
+    dtype: DataType
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.dtype
+
+    def __str__(self):
+        return f"CAST({self.child} AS {self.dtype})"
+
+
+@dataclass(eq=False, frozen=True)
+class Case(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE e END."""
+
+    branches: Tuple[Tuple[Expression, Expression], ...]
+    else_value: Optional[Expression]
+
+    def children(self):
+        out = []
+        for c, v in self.branches:
+            out += [c, v]
+        if self.else_value is not None:
+            out.append(self.else_value)
+        return tuple(out)
+
+    def data_type(self, schema):
+        dt = self.branches[0][1].data_type(schema)
+        for _, v in self.branches[1:]:
+            dt = T.common_type(dt, v.data_type(schema))
+        if self.else_value is not None:
+            dt = T.common_type(dt, self.else_value.data_type(schema))
+        return dt
+
+    def __str__(self):
+        return "CASE ..."
+
+
+@dataclass(eq=False, frozen=True)
+class Coalesce(Expression):
+    args: Tuple[Expression, ...]
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        dt = self.args[0].data_type(schema)
+        for a in self.args[1:]:
+            dt = T.common_type(dt, a.data_type(schema))
+        return dt
+
+    def __str__(self):
+        return f"COALESCE({', '.join(map(str, self.args))})"
+
+
+@dataclass(eq=False, frozen=True)
+class ExtractDatePart(Expression):
+    """EXTRACT(YEAR|MONTH|DAY FROM date_expr)."""
+
+    part: str  # 'year' | 'month' | 'day'
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def __str__(self):
+        return f"EXTRACT({self.part} FROM {self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class AddMonths(Expression):
+    child: Expression
+    months: int
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.DATE
+
+    def __str__(self):
+        return f"ADD_MONTHS({self.child}, {self.months})"
+
+
+@dataclass(eq=False, frozen=True)
+class StringPredicate(Expression):
+    """startswith / endswith / contains — host dictionary evaluation."""
+
+    op: str  # 'startswith' | 'endswith' | 'contains'
+    child: Expression
+    needle: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"{self.op}({self.child}, {self.needle!r})"
+
+
+@dataclass(eq=False, frozen=True)
+class Substring(Expression):
+    """SUBSTRING(str, pos, len) — 1-based, host dictionary transform."""
+
+    child: Expression
+    pos: int
+    length: int
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def __str__(self):
+        return f"SUBSTRING({self.child}, {self.pos}, {self.length})"
+
+
+@dataclass(eq=False, frozen=True)
+class Abs(Expression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def __str__(self):
+        return f"ABS({self.child})"
+
+
+# ---- sort order ------------------------------------------------------------
+
+
+@dataclass(eq=False, frozen=True)
+class SortOrder(Expression):
+    """Sort key wrapper (reference: expressions/SortOrder.scala).
+    nulls_first default matches Spark: NULLS FIRST for ASC, LAST for DESC."""
+
+    child: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def nulls_first_resolved(self) -> bool:
+        if self.nulls_first is not None:
+            return self.nulls_first
+        return self.ascending
+
+    def __str__(self):
+        d = "ASC" if self.ascending else "DESC"
+        return f"{self.child} {d}"
+
+
+# ---- aggregates ------------------------------------------------------------
+
+
+class AggregateExpression(Expression):
+    """Marker base for aggregate functions (reference:
+    expressions/aggregate/)."""
+
+    def data_type(self, schema):
+        raise NotImplementedError
+
+
+@dataclass(eq=False, frozen=True)
+class Sum(AggregateExpression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        dt = self.child.data_type(schema)
+        if dt.is_integral:
+            return T.INT64
+        return dt
+
+    @property
+    def name(self):
+        return f"sum({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Avg(AggregateExpression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    @property
+    def name(self):
+        return f"avg({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Count(AggregateExpression):
+    """COUNT(expr); COUNT(*) is Count(None)."""
+
+    child: Optional[Expression] = None
+    distinct: bool = False
+
+    def children(self):
+        return (self.child,) if self.child is not None else ()
+
+    def data_type(self, schema):
+        return T.INT64
+
+    def nullable(self, schema):
+        return False
+
+    @property
+    def name(self):
+        inner = "*" if self.child is None else str(self.child)
+        d = "DISTINCT " if self.distinct else ""
+        return f"count({d}{inner})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Min(AggregateExpression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        return f"min({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Max(AggregateExpression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        return f"max({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class StddevVariance(AggregateExpression):
+    """stddev_samp/stddev_pop/var_samp/var_pop via Welford-free
+    sum/sum-of-squares formulation (matches benchmark parity targets,
+    reference: AggregateBenchmark stddev row)."""
+
+    kind: str  # 'stddev_samp' | 'stddev_pop' | 'var_samp' | 'var_pop'
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    @property
+    def name(self):
+        return f"{self.kind}({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class First(AggregateExpression):
+    child: Expression
+    ignore_nulls: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        return f"first({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+def strip_alias(e: Expression) -> Expression:
+    while isinstance(e, Alias):
+        e = e.child
+    return e
+
+
+def contains_aggregate(e: Expression) -> bool:
+    if isinstance(e, AggregateExpression):
+        return True
+    return any(contains_aggregate(c) for c in e.children())
+
+
+def collect_aggregates(e: Expression) -> list:
+    if isinstance(e, AggregateExpression):
+        return [e]
+    out = []
+    for c in e.children():
+        out.extend(collect_aggregates(c))
+    return out
+
+
+def transform_expr(e: Expression, fn) -> Expression:
+    """Bottom-up expression transform (TreeNode.transformUp analogue,
+    reference: catalyst/trees/TreeNode.scala)."""
+    import dataclasses
+
+    new_fields = {}
+    changed = False
+    for f_name, f_val in vars(e).items():
+        if isinstance(f_val, Expression):
+            nv = transform_expr(f_val, fn)
+            changed |= nv is not f_val
+            new_fields[f_name] = nv
+        elif isinstance(f_val, tuple) and f_val and any(
+            isinstance(x, Expression)
+            or (isinstance(x, tuple) and any(isinstance(y, Expression) for y in x))
+            for x in f_val
+        ):
+            nlist = []
+            for x in f_val:
+                if isinstance(x, Expression):
+                    nx = transform_expr(x, fn)
+                    changed |= nx is not x
+                    nlist.append(nx)
+                elif isinstance(x, tuple):
+                    ny = tuple(
+                        transform_expr(y, fn) if isinstance(y, Expression) else y
+                        for y in x
+                    )
+                    changed |= ny != x
+                    nlist.append(ny)
+                else:
+                    nlist.append(x)
+            new_fields[f_name] = tuple(nlist)
+        else:
+            new_fields[f_name] = f_val
+    if changed:
+        e = dataclasses.replace(e, **{
+            k: v for k, v in new_fields.items()
+            if k in {fl.name for fl in dataclasses.fields(e)}
+        })
+    return fn(e)

@@ -1,0 +1,31 @@
+"""Test harness: a 'local-mesh' analogue of the reference's local[N] /
+local-cluster[n,c,m] master URLs (reference: SparkContext master parsing;
+LocalSparkCluster.scala) — 8 virtual CPU devices so distributed paths are
+exercised without TPU hardware (SURVEY.md §4 'Lesson for the TPU build')."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def spark():
+    from spark_tpu.api.session import SparkSession
+
+    return SparkSession.builder.getOrCreate()

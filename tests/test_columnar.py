@@ -1,0 +1,53 @@
+import datetime
+
+import numpy as np
+import pyarrow as pa
+
+from spark_tpu import types as T
+from spark_tpu.columnar import from_arrow, from_numpy, to_arrow
+from spark_tpu.types import Field, Schema
+
+
+def test_from_numpy_roundtrip():
+    schema = Schema((Field("a", T.INT64, False), Field("b", T.FLOAT64, False)))
+    batch = from_numpy(schema, [np.arange(10), np.arange(10) * 0.5])
+    assert batch.capacity == 1024
+    assert batch.num_valid_rows() == 10
+    rows = batch.to_pylist()
+    assert rows[3] == {"a": 3, "b": 1.5}
+
+
+def test_arrow_roundtrip_nulls_strings_dates():
+    table = pa.table({
+        "i": pa.array([1, None, 3], type=pa.int64()),
+        "s": pa.array(["x", "y", None], type=pa.string()),
+        "d": pa.array([datetime.date(1995, 3, 15), None,
+                       datetime.date(1998, 12, 1)], type=pa.date32()),
+        "f": pa.array([1.5, 2.5, 3.5], type=pa.float64()),
+    })
+    batch = from_arrow(table)
+    assert batch.schema.field("s").dtype == T.STRING
+    assert batch.schema.field("s").dictionary is not None
+    rows = batch.to_pylist()
+    assert rows[0]["i"] == 1 and rows[1]["i"] is None
+    assert rows[0]["s"] == "x" and rows[2]["s"] is None
+    assert rows[0]["d"] == datetime.date(1995, 3, 15)
+    assert rows[1]["d"] is None
+
+    back = to_arrow(batch)
+    assert back.column("i").to_pylist() == [1, None, 3]
+    assert back.column("s").to_pylist() == ["x", "y", None]
+    assert back.column("d").to_pylist() == [
+        datetime.date(1995, 3, 15), None, datetime.date(1998, 12, 1)]
+
+
+def test_decimal_maps_to_float64():
+    import decimal
+    table = pa.table({
+        "p": pa.array([decimal.Decimal("12.34"), decimal.Decimal("56.78")],
+                      type=pa.decimal128(12, 2)),
+    })
+    batch = from_arrow(table)
+    assert isinstance(batch.schema.field("p").dtype, T.DecimalType)
+    rows = batch.to_pylist()
+    assert abs(rows[0]["p"] - 12.34) < 1e-9

@@ -1,0 +1,160 @@
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu import types as T
+from spark_tpu.columnar import from_arrow
+from spark_tpu.expr import Env, evaluate
+from spark_tpu.expr import expressions as E
+
+
+def make_env():
+    table = pa.table({
+        "i": pa.array([1, 2, None, 4], type=pa.int64()),
+        "f": pa.array([0.5, 1.5, 2.5, 3.5], type=pa.float64()),
+        "s": pa.array(["apple", "banana", "apple", None], type=pa.string()),
+        "d": pa.array([datetime.date(1995, 1, 1), datetime.date(1996, 6, 15),
+                       datetime.date(1997, 12, 31), datetime.date(2000, 2, 29)],
+                      type=pa.date32()),
+        "b": pa.array([True, False, True, None], type=pa.bool_()),
+    })
+    batch = from_arrow(table, capacity=8)
+    return Env.from_batch(batch), batch
+
+
+def live(tv, batch, n=4):
+    data = np.asarray(tv.data)[:n]
+    valid = (np.ones(n, dtype=bool) if tv.validity is None
+             else np.asarray(tv.validity)[:n])
+    return [d.item() if v else None for d, v in zip(data, valid)]
+
+
+def test_arith_null_propagation():
+    env, batch = make_env()
+    tv = evaluate(E.Col("i") + E.Literal(10), env)
+    assert live(tv, batch) == [11, 12, None, 14]
+
+
+def test_division_by_zero_is_null():
+    env, batch = make_env()
+    tv = evaluate(E.Col("f") / (E.Col("i") - E.Literal(2)), env)
+    out = live(tv, batch)
+    assert out[0] == pytest.approx(-0.5)
+    assert out[1] is None  # div by zero
+    assert out[2] is None  # null operand
+
+
+def test_comparison_and_kleene_logic():
+    env, batch = make_env()
+    tv = evaluate((E.Col("i") > 1) & (E.Col("f") < 3.0), env)
+    assert live(tv, batch) == [False, True, None, False]
+    tv = evaluate((E.Col("i") > 100) | E.Col("b"), env)
+    assert live(tv, batch) == [True, False, True, None]
+    # Kleene: true AND null -> null (row 2); null AND false -> false (row 3)
+    tv = evaluate(E.Col("b") & (E.Col("i") > 100), env)
+    assert live(tv, batch) == [False, False, None, False]
+
+
+def test_string_equality_and_like():
+    env, batch = make_env()
+    tv = evaluate(E.Col("s") == E.Literal("apple"), env)
+    assert live(tv, batch) == [True, False, True, None]
+    tv = evaluate(E.Like(E.Col("s"), "%an%"), env)
+    assert live(tv, batch) == [False, True, False, None]
+    tv = evaluate(E.StringPredicate("startswith", E.Col("s"), "app"), env)
+    assert live(tv, batch) == [True, False, True, None]
+
+
+def test_string_ordering_comparison():
+    env, batch = make_env()
+    tv = evaluate(E.Cmp("<", E.Col("s"), E.Literal("az")), env)
+    assert live(tv, batch) == [True, False, True, None]
+
+
+def test_in_and_between():
+    env, batch = make_env()
+    tv = evaluate(E.Col("i").isin(1, 4), env)
+    assert live(tv, batch) == [True, False, None, True]
+    tv = evaluate(E.Col("f").between(1.0, 3.0), env)
+    assert live(tv, batch) == [False, True, True, False]
+
+
+def test_is_null():
+    env, batch = make_env()
+    tv = evaluate(E.IsNull(E.Col("i")), env)
+    assert live(tv, batch) == [False, False, True, False]
+
+
+def test_date_compare_and_extract():
+    env, batch = make_env()
+    tv = evaluate(E.Col("d") < E.Literal(datetime.date(1997, 1, 1)), env)
+    assert live(tv, batch) == [True, True, False, False]
+    tv = evaluate(E.ExtractDatePart("year", E.Col("d")), env)
+    assert live(tv, batch) == [1995, 1996, 1997, 2000]
+    tv = evaluate(E.ExtractDatePart("month", E.Col("d")), env)
+    assert live(tv, batch) == [1, 6, 12, 2]
+    tv = evaluate(E.ExtractDatePart("day", E.Col("d")), env)
+    assert live(tv, batch) == [1, 15, 31, 29]
+
+
+def test_date_arith_and_add_months():
+    env, batch = make_env()
+    tv = evaluate(E.Col("d") + E.Literal(90), env)
+    assert live(tv, batch)[0] == T.date_to_days(datetime.date(1995, 4, 1))
+    tv = evaluate(E.AddMonths(E.Col("d"), 3), env)
+    expect = [datetime.date(1995, 4, 1), datetime.date(1996, 9, 15),
+              datetime.date(1998, 3, 31), datetime.date(2000, 5, 29)]
+    assert live(tv, batch) == [T.date_to_days(d) for d in expect]
+    # clamp: Jan 31 + 1 month = Feb 28
+    tv = evaluate(E.AddMonths(E.Literal(datetime.date(1999, 1, 31)), 1), env)
+    assert live(tv, batch)[0] == T.date_to_days(datetime.date(1999, 2, 28))
+
+
+def test_case_when_string_output():
+    env, batch = make_env()
+    expr = E.Case(
+        branches=((E.Col("s") == E.Literal("apple"), E.Literal("FRUIT_A")),
+                  (E.Col("s") == E.Literal("banana"), E.Literal("FRUIT_B"))),
+        else_value=E.Literal("OTHER"),
+    )
+    tv = evaluate(expr, env)
+    vals = live(tv, batch)
+    decoded = [tv.dictionary[v] if v is not None else None for v in vals]
+    assert decoded == ["FRUIT_A", "FRUIT_B", "FRUIT_A", "OTHER"]
+
+
+def test_case_when_numeric():
+    env, batch = make_env()
+    expr = E.Case(branches=((E.Col("i") > 1, E.Col("f") * 10),),
+                  else_value=E.Literal(0.0))
+    tv = evaluate(expr, env)
+    assert live(tv, batch) == [0.0, 15.0, 0.0, 35.0]
+
+
+def test_substring():
+    env, batch = make_env()
+    tv = evaluate(E.Substring(E.Col("s"), 1, 3), env)
+    decoded = [tv.dictionary[v] if v is not None else None
+               for v in live(tv, batch)]
+    assert decoded == ["app", "ban", "app", None]
+
+
+def test_cast():
+    env, batch = make_env()
+    tv = evaluate(E.Cast(E.Col("i"), T.FLOAT64), env)
+    assert live(tv, batch) == [1.0, 2.0, None, 4.0]
+
+
+def test_coalesce():
+    env, batch = make_env()
+    tv = evaluate(E.Coalesce((E.Col("i"), E.Literal(99))), env)
+    assert live(tv, batch) == [1, 2, 99, 4]
+
+
+def test_mod_sign():
+    env, batch = make_env()
+    tv = evaluate(E.Arith("%", E.Col("i") - 3, E.Literal(2)), env)
+    # SQL: (-2) % 2 = 0, (-1) % 2 = -1 (sign of dividend)
+    assert live(tv, batch) == [0, -1, None, 1]
