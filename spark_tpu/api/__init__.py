@@ -1,0 +1,3 @@
+from spark_tpu.api.session import SparkSession  # noqa: F401
+from spark_tpu.api.dataframe import DataFrame  # noqa: F401
+from spark_tpu.api.row import Row  # noqa: F401

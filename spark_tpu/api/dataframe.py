@@ -1,0 +1,390 @@
+"""DataFrame: lazy logical-plan builder + actions (reference:
+sql/core/src/main/scala/org/apache/spark/sql/Dataset.scala — collect:3432
+withAction:4173; python surface python/pyspark/sql/dataframe.py).
+
+A DataFrame is (session, logical plan). Transformations build new plans;
+actions run optimize -> physical plan -> stage-fused execution
+(QueryExecution.scala:55 pipeline analogue, see physical/planner.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from spark_tpu.api.row import Row
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+from spark_tpu.types import Schema
+
+ColumnOrName = Union[E.Expression, str]
+
+
+def _c(c: ColumnOrName) -> E.Expression:
+    return c if isinstance(c, E.Expression) else E.Col(c)
+
+
+def _order(c: ColumnOrName) -> E.SortOrder:
+    e = _c(c)
+    if isinstance(e, E.SortOrder):
+        return e
+    return E.SortOrder(e, ascending=True)
+
+
+class DataFrame:
+    def __init__(self, session, plan: L.LogicalPlan):
+        self._session = session
+        self._plan = plan
+
+    # ---- metadata ----------------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._plan.schema.names)
+
+    @property
+    def sparkSession(self):
+        return self._session
+
+    def explain(self, extended: bool = False) -> None:
+        from spark_tpu.plan.optimizer import optimize
+        from spark_tpu.physical.planner import plan_physical
+
+        print("== Logical Plan ==")
+        print(self._plan.tree_string())
+        opt = optimize(self._plan)
+        if extended:
+            print("== Optimized Logical Plan ==")
+            print(opt.tree_string())
+        print("== Physical Plan ==")
+        print(plan_physical(opt).tree_string())
+
+    def _with(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(self._session, plan)
+
+    # ---- transformations ---------------------------------------------------
+
+    def select(self, *cols: ColumnOrName) -> "DataFrame":
+        if not cols:
+            cols = tuple(self.columns)
+        exprs: List[E.Expression] = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                exprs.extend(E.Col(n) for n in self.columns)
+            else:
+                exprs.append(_c(c))
+        return self._with(L.Project(tuple(exprs), self._plan))
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        from spark_tpu.sql.parser import parse_projection
+
+        parsed = [parse_projection(s, self._plan.schema) for s in exprs]
+        return self._with(L.Project(tuple(parsed), self._plan))
+
+    def filter(self, condition: Union[E.Expression, str]) -> "DataFrame":
+        if isinstance(condition, str):
+            from spark_tpu.sql.parser import parse_expression
+
+            condition = parse_expression(condition)
+        return self._with(L.Filter(condition, self._plan))
+
+    where = filter
+
+    def withColumn(self, name: str, col: E.Expression) -> "DataFrame":
+        exprs = []
+        replaced = False
+        for n in self.columns:
+            if n == name:
+                exprs.append(E.Alias(col, name))
+                replaced = True
+            else:
+                exprs.append(E.Col(n))
+        if not replaced:
+            exprs.append(E.Alias(col, name))
+        return self._with(L.Project(tuple(exprs), self._plan))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = tuple(
+            E.Alias(E.Col(n), new) if n == old else E.Col(n)
+            for n in self.columns)
+        return self._with(L.Project(exprs, self._plan))
+
+    def drop(self, *names: str) -> "DataFrame":
+        drop = set(names)
+        exprs = tuple(E.Col(n) for n in self.columns if n not in drop)
+        return self._with(L.Project(exprs, self._plan))
+
+    def alias(self, name: str) -> "DataFrame":
+        return self._with(L.SubqueryAlias(name, self._plan))
+
+    def distinct(self) -> "DataFrame":
+        return self._with(L.Distinct(self._plan))
+
+    def dropDuplicates(self, subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        keys = tuple(E.Col(n) for n in subset)
+        outs = tuple(
+            E.Col(n) if n in set(subset) else E.Alias(E.First(E.Col(n)), n)
+            for n in self.columns)
+        return self._with(L.Aggregate(keys, outs, self._plan))
+
+    drop_duplicates = dropDuplicates
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._with(L.Limit(n, self._plan))
+
+    def offset(self, n: int) -> "DataFrame":
+        return self._with(L.Limit(1 << 62, self._plan, offset=n))
+
+    def sort(self, *cols: ColumnOrName, ascending=None) -> "DataFrame":
+        orders = [_order(c) for c in cols]
+        if ascending is not None:
+            flags = ([ascending] * len(orders)
+                     if isinstance(ascending, bool) else list(ascending))
+            orders = [
+                E.SortOrder(o.child, asc, o.nulls_first)
+                for o, asc in zip(orders, flags)
+            ]
+        return self._with(L.Sort(tuple(orders), self._plan))
+
+    orderBy = sort
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.Union(self._plan, other._plan))
+
+    unionAll = union
+
+    def unionByName(self, other: "DataFrame") -> "DataFrame":
+        reordered = other.select(*[E.Col(n) for n in self.columns])
+        return self._with(L.Union(self._plan, reordered._plan))
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        return self._with(L.Sample(fraction, seed, self._plan))
+
+    def repartition(self, num_partitions: int, *cols: ColumnOrName) -> "DataFrame":
+        return self._with(L.Repartition(
+            num_partitions, tuple(_c(c) for c in cols), self._plan))
+
+    def coalesce(self, num_partitions: int) -> "DataFrame":
+        return self._with(L.Repartition(num_partitions, (), self._plan))
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+        how = {"outer": "full", "full_outer": "full", "fullouter": "full",
+               "leftouter": "left", "left_outer": "left",
+               "rightouter": "right", "right_outer": "right",
+               "semi": "left_semi", "leftsemi": "left_semi",
+               "anti": "left_anti", "leftanti": "left_anti"}.get(how, how)
+        if how not in L.JOIN_TYPES:
+            raise ValueError(f"unsupported join type {how!r}")
+        if on is None:
+            return self._with(L.Join(self._plan, other._plan, "cross", (), ()))
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lkeys = tuple(E.Col(n) for n in on)
+            rkeys = tuple(E.Col(n) for n in on)
+            joined = L.Join(self._plan, other._plan, how, lkeys, rkeys)
+            if how in ("left_semi", "left_anti"):
+                return self._with(joined)
+            # name-based join keeps ONE copy of the join columns (Spark
+            # semantics, Dataset.join(usingColumns)); the right-side copy
+            # appears as 'name#2' after the Join.schema dedup
+            on_set = set(on)
+            right_start = len(self._plan.schema.names)
+            joined_names = list(joined.schema.names)
+            # the right copy of join column `k` is `k` or `k#2` post-dedup
+            right_copy = {}
+            for i, n in enumerate(joined_names):
+                base = n[:-2] if n.endswith("#2") else n
+                if i >= right_start and base in on_set:
+                    right_copy[base] = n
+            keep = []
+            for i, n in enumerate(joined_names):
+                if i >= right_start and n in right_copy.values():
+                    continue
+                if i < right_start and n in on_set and how in ("right", "full"):
+                    # usingColumns full outer merges the key columns
+                    keep.append(E.Alias(
+                        E.Coalesce((E.Col(n), E.Col(right_copy[n]))), n))
+                else:
+                    keep.append(E.Col(n))
+            return self._with(L.Project(tuple(keep), joined))
+        # Column expression: extract equi conjuncts
+        cond = on
+        lnames = set(self._plan.schema.names)
+        rnames = set(other._plan.schema.names)
+        lkeys_l: List[E.Expression] = []
+        rkeys_l: List[E.Expression] = []
+        residual: List[E.Expression] = []
+        from spark_tpu.plan.optimizer import split_conjuncts, combine_conjuncts
+
+        for c in split_conjuncts(cond):
+            if isinstance(c, E.Cmp) and c.op == "==":
+                lr, rr = c.left.references(), c.right.references()
+                if lr <= lnames and rr <= rnames:
+                    lkeys_l.append(c.left)
+                    rkeys_l.append(c.right)
+                    continue
+                if lr <= rnames and rr <= lnames:
+                    lkeys_l.append(c.right)
+                    rkeys_l.append(c.left)
+                    continue
+            residual.append(c)
+        res = combine_conjuncts(residual) if residual else None
+        if not lkeys_l and how == "inner":
+            return self._with(L.Join(self._plan, other._plan, "cross", (), (),
+                                     condition=res))
+        return self._with(L.Join(self._plan, other._plan, how,
+                                 tuple(lkeys_l), tuple(rkeys_l), res))
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.Join(self._plan, other._plan, "cross", (), ()))
+
+    def groupBy(self, *cols: ColumnOrName) -> "GroupedData":
+        return GroupedData(self, tuple(_c(c) for c in cols))
+
+    groupby = groupBy
+
+    def agg(self, *exprs: E.Expression) -> "DataFrame":
+        return self.groupBy().agg(*exprs)
+
+    def __getitem__(self, item):
+        if isinstance(item, str):
+            return E.Col(item)
+        if isinstance(item, E.Expression):
+            return self.filter(item)
+        raise TypeError(item)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._plan.schema:
+            return E.Col(name)
+        raise AttributeError(name)
+
+    # ---- actions -----------------------------------------------------------
+
+    def _execute(self):
+        from spark_tpu.physical.planner import execute_logical
+
+        return execute_logical(self._plan)
+
+    def collect(self) -> List[Row]:
+        batch = self._execute()
+        return [Row.from_dict(d) for d in batch.to_pylist()]
+
+    def toPandas(self):
+        return self._execute().to_pandas()
+
+    def toArrow(self):
+        from spark_tpu.columnar.arrow import to_arrow
+
+        return to_arrow(self._execute())
+
+    def count(self) -> int:
+        agg = L.Aggregate((), (E.Alias(E.Count(None), "count"),), self._plan)
+        from spark_tpu.physical.planner import execute_logical
+
+        batch = execute_logical(agg)
+        return int(batch.to_pylist()[0]["count"])
+
+    def first(self) -> Optional[Row]:
+        rows = self.limit(1).collect()
+        return rows[0] if rows else None
+
+    def head(self, n: int = 1):
+        rows = self.limit(n).collect()
+        return rows[0] if n == 1 and rows else rows
+
+    def take(self, n: int) -> List[Row]:
+        return self.limit(n).collect()
+
+    def isEmpty(self) -> bool:
+        return len(self.take(1)) == 0
+
+    def show(self, n: int = 20, truncate: bool = True) -> None:
+        rows = self.limit(n).collect()
+        names = self.columns
+        cells = [[_fmt(r[c], truncate) for c in names] for r in rows]
+        widths = [
+            max(len(str(nm)), *(len(row[i]) for row in cells)) if cells
+            else len(str(nm))
+            for i, nm in enumerate(names)
+        ]
+        sep = "+" + "+".join("-" * w for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(str(nm).ljust(w)
+                             for nm, w in zip(names, widths)) + "|")
+        print(sep)
+        for row in cells:
+            print("|" + "|".join(v.ljust(w) for v, w in zip(row, widths)) + "|")
+        print(sep)
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        self._session.catalog._register_view(name, self._plan)
+
+    def cache(self) -> "DataFrame":
+        """Materialize once and swap in the result (reference:
+        CacheManager.scala / InMemoryRelation — here the 'columnar cached
+        build' is simply the executed device batch)."""
+        batch = self._execute()
+        return self._with(L.Relation(batch))
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    def checkpoint(self) -> "DataFrame":
+        return self.cache()
+
+
+def _fmt(v, truncate: bool) -> str:
+    s = "NULL" if v is None else str(v)
+    if truncate and len(s) > 20:
+        s = s[:17] + "..."
+    return s
+
+
+class GroupedData:
+    """Result of groupBy (reference:
+    sql/core/.../RelationalGroupedDataset.scala)."""
+
+    def __init__(self, df: DataFrame, keys: Tuple[E.Expression, ...]):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *exprs: E.Expression) -> DataFrame:
+        outs = tuple(self._keys) + tuple(exprs)
+        return self._df._with(
+            L.Aggregate(self._keys, outs, self._df._plan))
+
+    def _simple(self, fn, cols: Tuple[str, ...]) -> DataFrame:
+        names = cols or tuple(
+            n for n in self._df.columns
+            if self._df.schema.field(n).dtype.is_numeric
+            and not any(k.name == n for k in self._keys))
+        aggs = tuple(E.Alias(fn(E.Col(n)), f"{fn.__name__.lower()}({n})")
+                     for n in names)
+        return self.agg(*aggs)
+
+    def sum(self, *cols: str) -> DataFrame:  # noqa: A003
+        return self._simple(E.Sum, cols)
+
+    def avg(self, *cols: str) -> DataFrame:
+        return self._simple(E.Avg, cols)
+
+    mean = avg
+
+    def min(self, *cols: str) -> DataFrame:  # noqa: A003
+        return self._simple(E.Min, cols)
+
+    def max(self, *cols: str) -> DataFrame:  # noqa: A003
+        return self._simple(E.Max, cols)
+
+    def count(self) -> DataFrame:
+        return self.agg(E.Alias(E.Count(None), "count"))

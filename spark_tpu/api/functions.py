@@ -1,0 +1,202 @@
+"""Column functions — the pyspark.sql.functions surface (reference:
+sql/core/src/main/scala/org/apache/spark/sql/functions.scala,
+python/pyspark/sql/functions/). Columns ARE expression trees here
+(no Py4J indirection): every function builds an expr/expressions node.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from spark_tpu.expr import expressions as E
+from spark_tpu import types as T
+
+Column = E.Expression
+ColumnOrName = Union[Column, str]
+
+
+def _c(c: ColumnOrName) -> Column:
+    return c if isinstance(c, E.Expression) else E.Col(c)
+
+
+def col(name: str) -> Column:
+    return E.Col(name)
+
+
+column = col
+
+
+def lit(value: Any) -> Column:
+    if isinstance(value, E.Expression):
+        return value
+    return E.Literal(value)
+
+
+def expr(sql_text: str) -> Column:
+    """Parse a SQL expression string (reference: functions.expr)."""
+    from spark_tpu.sql.parser import parse_expression
+
+    return parse_expression(sql_text)
+
+
+# ---- aggregates ------------------------------------------------------------
+
+
+def sum(c: ColumnOrName) -> Column:  # noqa: A001
+    return E.Sum(_c(c))
+
+
+def avg(c: ColumnOrName) -> Column:
+    return E.Avg(_c(c))
+
+
+mean = avg
+
+
+def count(c: ColumnOrName = "*") -> Column:
+    if isinstance(c, str) and c == "*":
+        return E.Count(None)
+    return E.Count(_c(c))
+
+
+def countDistinct(c: ColumnOrName) -> Column:
+    return E.Count(_c(c), distinct=True)
+
+
+count_distinct = countDistinct
+
+
+def min(c: ColumnOrName) -> Column:  # noqa: A001
+    return E.Min(_c(c))
+
+
+def max(c: ColumnOrName) -> Column:  # noqa: A001
+    return E.Max(_c(c))
+
+
+def first(c: ColumnOrName, ignorenulls: bool = False) -> Column:
+    return E.First(_c(c), ignorenulls)
+
+
+def stddev(c: ColumnOrName) -> Column:
+    return E.StddevVariance("stddev_samp", _c(c))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c: ColumnOrName) -> Column:
+    return E.StddevVariance("stddev_pop", _c(c))
+
+
+def variance(c: ColumnOrName) -> Column:
+    return E.StddevVariance("var_samp", _c(c))
+
+
+var_samp = variance
+
+
+def var_pop(c: ColumnOrName) -> Column:
+    return E.StddevVariance("var_pop", _c(c))
+
+
+# ---- scalar ----------------------------------------------------------------
+
+
+def abs(c: ColumnOrName) -> Column:  # noqa: A001
+    return E.Abs(_c(c))
+
+
+def coalesce(*cols: ColumnOrName) -> Column:
+    return E.Coalesce(tuple(_c(c) for c in cols))
+
+
+def isnull(c: ColumnOrName) -> Column:
+    return E.IsNull(_c(c))
+
+
+def isnotnull(c: ColumnOrName) -> Column:
+    return E.Not(E.IsNull(_c(c)))
+
+
+def when(condition: Column, value: Any) -> E.Case:
+    """CASE builder; chain .when(...).otherwise(...) (an unterminated
+    chain is a valid CASE with NULL for unmatched rows)."""
+    return E.Case(((condition, lit(value)),), None)
+
+
+# ---- string ----------------------------------------------------------------
+
+
+def substring(c: ColumnOrName, pos: int, length: int) -> Column:
+    return E.Substring(_c(c), pos, length)
+
+
+def startswith(c: ColumnOrName, prefix: str) -> Column:
+    return E.StringPredicate("startswith", _c(c), prefix)
+
+
+def endswith(c: ColumnOrName, suffix: str) -> Column:
+    return E.StringPredicate("endswith", _c(c), suffix)
+
+
+def contains(c: ColumnOrName, needle: str) -> Column:
+    return E.StringPredicate("contains", _c(c), needle)
+
+
+def like(c: ColumnOrName, pattern: str) -> Column:
+    return E.Like(_c(c), pattern)
+
+
+# ---- temporal --------------------------------------------------------------
+
+
+def year(c: ColumnOrName) -> Column:
+    return E.ExtractDatePart("year", _c(c))
+
+
+def month(c: ColumnOrName) -> Column:
+    return E.ExtractDatePart("month", _c(c))
+
+
+def dayofmonth(c: ColumnOrName) -> Column:
+    return E.ExtractDatePart("day", _c(c))
+
+
+def add_months(c: ColumnOrName, months: int) -> Column:
+    return E.AddMonths(_c(c), months)
+
+
+def date_add(c: ColumnOrName, days: int) -> Column:
+    return E.Arith("+", _c(c), E.Literal(days))
+
+
+def date_sub(c: ColumnOrName, days: int) -> Column:
+    return E.Arith("-", _c(c), E.Literal(days))
+
+
+def datediff(end: ColumnOrName, start: ColumnOrName) -> Column:
+    return E.Arith("-", _c(end), _c(start))
+
+
+def to_date(c: ColumnOrName) -> Column:
+    return E.Cast(_c(c), T.DATE)
+
+
+# ---- ordering --------------------------------------------------------------
+
+
+def asc(c: ColumnOrName) -> Column:
+    return E.SortOrder(_c(c), ascending=True)
+
+
+def desc(c: ColumnOrName) -> Column:
+    return E.SortOrder(_c(c), ascending=False)
+
+
+def asc_nulls_last(c: ColumnOrName) -> Column:
+    return E.SortOrder(_c(c), ascending=True, nulls_first=False)
+
+
+def desc_nulls_first(c: ColumnOrName) -> Column:
+    return E.SortOrder(_c(c), ascending=False, nulls_first=True)

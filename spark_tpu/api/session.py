@@ -1,0 +1,171 @@
+"""SparkSession: the user entry point (reference:
+sql/core/src/main/scala/org/apache/spark/sql/SparkSession.scala and
+SparkContext.scala:85 — collapsed: there is no driver/executor split to
+bootstrap, the 'cluster' is the jax device mesh).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+
+from spark_tpu import types as T
+from spark_tpu.api.dataframe import DataFrame
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.plan import logical as L
+from spark_tpu.types import Field, Schema
+
+
+class Catalog:
+    """Temp-view + table registry (reference:
+    sql/catalyst/.../catalog/SessionCatalog.scala:61, pared to the
+    in-memory session catalog; file-backed tables register here too)."""
+
+    def __init__(self, session: "SparkSession"):
+        self._session = session
+        self._views: Dict[str, L.LogicalPlan] = {}
+
+    def _register_view(self, name: str, plan: L.LogicalPlan) -> None:
+        self._views[name.lower()] = plan
+
+    def lookup(self, name: str) -> L.LogicalPlan:
+        key = name.lower()
+        if key not in self._views:
+            raise KeyError(f"table or view not found: {name}")
+        return self._views[key]
+
+    def listTables(self) -> List[str]:
+        return sorted(self._views)
+
+    def dropTempView(self, name: str) -> bool:
+        return self._views.pop(name.lower(), None) is not None
+
+    def tableExists(self, name: str) -> bool:
+        return name.lower() in self._views
+
+
+class SparkSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, Any] = {}
+        self._app_name = "spark-tpu"
+
+    def appName(self, name: str) -> "SparkSessionBuilder":
+        self._app_name = name
+        return self
+
+    def master(self, _url: str) -> "SparkSessionBuilder":
+        return self  # the mesh IS the cluster
+
+    def config(self, key: str, value: Any) -> "SparkSessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def getOrCreate(self) -> "SparkSession":
+        if SparkSession._active is None:
+            SparkSession._active = SparkSession(self._app_name, self._conf)
+        else:
+            for k, v in self._conf.items():
+                SparkSession._active.conf.set(k, v)
+        return SparkSession._active
+
+
+class SparkSession:
+    _active: Optional["SparkSession"] = None
+
+    builder = SparkSessionBuilder()
+
+    def __init__(self, app_name: str = "spark-tpu",
+                 conf: Optional[Dict[str, Any]] = None):
+        # SQL engines need 64-bit ints/floats; flip jax's default.
+        jax.config.update("jax_enable_x64", True)
+        self.app_name = app_name
+        self.conf = RuntimeConf(conf)
+        self.catalog = Catalog(self)
+        self._read = None
+
+    # -- builder is reset-safe for tests
+    @classmethod
+    def _reset(cls):
+        cls._active = None
+        cls.builder = SparkSessionBuilder()
+
+    @property
+    def read(self):
+        from spark_tpu.io.readwriter import DataFrameReader
+
+        return DataFrameReader(self)
+
+    @property
+    def readStream(self):
+        from spark_tpu.streaming.readwriter import DataStreamReader
+
+        return DataStreamReader(self)
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1, numSlices: Optional[int] = None) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.Range(int(start), int(end), int(step)))
+
+    def table(self, name: str) -> DataFrame:
+        return DataFrame(self, self.catalog.lookup(name))
+
+    def sql(self, query: str) -> DataFrame:
+        from spark_tpu.sql.parser import parse_sql
+
+        plan = parse_sql(query, self.catalog)
+        return DataFrame(self, plan)
+
+    def createDataFrame(
+        self,
+        data: Union["pa.Table", "pd.DataFrame", Iterable],
+        schema: Optional[Union[Schema, Sequence[str]]] = None,
+    ) -> DataFrame:
+        import pandas as pd
+        import pyarrow as pa
+
+        from spark_tpu.columnar.arrow import from_arrow
+
+        if isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, pd.DataFrame):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        else:
+            rows = list(data)
+            if not rows:
+                raise ValueError("cannot create DataFrame from empty data "
+                                 "without an explicit arrow/pandas input")
+            if isinstance(rows[0], dict):
+                names = list(rows[0].keys())
+                cols = {n: [r[n] for r in rows] for n in names}
+            else:
+                if schema is None:
+                    raise ValueError("tuple rows require column names")
+                names = (list(schema.names) if isinstance(schema, Schema)
+                         else list(schema))
+                cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+            table = pa.table(cols)
+        df = DataFrame(self, L.Relation(from_arrow(table)))
+        if isinstance(schema, Sequence) and not isinstance(schema, Schema) \
+                and schema is not None and not isinstance(schema, str):
+            old = df.columns
+            if list(schema) != old and len(schema) == len(old):
+                for o, n in zip(old, schema):
+                    df = df.withColumnRenamed(o, n)
+        return df
+
+    def stop(self) -> None:
+        SparkSession._reset()
+
+    @property
+    def version(self) -> str:
+        from spark_tpu import __version__
+
+        return __version__
+
+    def __repr__(self):
+        return f"<SparkSession app={self.app_name} devices={jax.device_count()}>"

@@ -94,12 +94,26 @@ def _column_to_numpy(
     if isinstance(dtype, T.StringType):
         if not pa.types.is_dictionary(arr.type):
             arr = pc.dictionary_encode(arr)
-        dictionary = tuple(arr.dictionary.to_pylist())
+        # pre-encoded dictionaries may contain a null entry; rows mapping
+        # to it are nulls (validity already covers them) — use "" so the
+        # sort below stays total
+        raw_dict = [s if s is not None else ""
+                    for s in arr.dictionary.to_pylist()]
         codes = pc.fill_null(arr.indices, 0).to_numpy(zero_copy_only=False)
         values = np.ascontiguousarray(codes, dtype=np.int32)
+        # Normalize to a SORTED dictionary so code order == lexicographic
+        # order: string min/max/compare/sort become plain int32 ops on
+        # device (no rank tables needed).
+        order = sorted(range(len(raw_dict)), key=lambda i: raw_dict[i])
+        remap = np.empty(len(raw_dict), dtype=np.int32)
+        for new_code, old_code in enumerate(order):
+            remap[old_code] = new_code
+        dictionary = tuple(raw_dict[i] for i in order)
+        if len(remap):
+            values = remap[values]
         if validity is not None:
             values = np.where(validity, values, 0).astype(np.int32)
-        return values, validity, dictionary
+        return values.astype(np.int32, copy=False), validity, dictionary
 
     if isinstance(dtype, T.DecimalType):
         arr = arr.cast(pa.float64())

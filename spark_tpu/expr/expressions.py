@@ -396,10 +396,19 @@ class Cast(Expression):
 
 @dataclass(eq=False, frozen=True)
 class Case(Expression):
-    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE e END."""
+    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE e END. With no ELSE,
+    unmatched rows are NULL (SQL semantics). ``when``/``otherwise``
+    make this directly chainable (pyspark's F.when().when().otherwise())."""
 
     branches: Tuple[Tuple[Expression, Expression], ...]
     else_value: Optional[Expression]
+
+    def when(self, condition: "Expression", value: Any) -> "Case":
+        return Case(self.branches + ((condition, lit_or_expr(value)),),
+                    self.else_value)
+
+    def otherwise(self, value: Any) -> "Case":
+        return Case(self.branches, lit_or_expr(value))
 
     def children(self):
         out = []

@@ -1,0 +1,1 @@
+from spark_tpu.physical import kernels, operators, planner  # noqa: F401

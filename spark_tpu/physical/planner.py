@@ -1,0 +1,189 @@
+"""Logical -> physical planning and the stage-fusing executor.
+
+Planning mirrors SparkPlanner/SparkStrategies (reference:
+sql/core/.../SparkPlanner.scala:28, SparkStrategies.scala Aggregation:522
+JoinSelection:172 BasicOperators:750) collapsed into one pass — there is
+a single physical choice per logical operator, with strategy decisions
+(direct vs sort aggregation) deferred to trace-time metadata.
+
+Execution replaces the whole SparkPlan.execute -> RDD -> DAGScheduler
+machinery (reference: SparkPlan.scala:191, QueryExecution.scala:168):
+maximal *traceable* subtrees are fused into one jitted XLA program (the
+WholeStageCodegenExec.scala:627 analogue — CollapseCodegenStages:882
+becomes "walk until a blocking operator"), blocking operators run
+eagerly between stages with host syncs for output sizing (the AQE
+stage-boundary analogue, reference: AdaptiveSparkPlanExec.scala:247).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from spark_tpu.columnar.batch import Batch
+from spark_tpu.expr import expressions as E
+from spark_tpu.physical import kernels as K
+from spark_tpu.physical import operators as P
+from spark_tpu.plan import logical as L
+
+
+def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
+    if isinstance(plan, L.Relation):
+        return P.BatchScanExec(plan.batch)
+    if isinstance(plan, L.Range):
+        return P.RangeExec(plan.start, plan.end, plan.step, plan.col_name)
+    if isinstance(plan, L.UnresolvedScan):
+        return P.BatchScanExec(plan.source.read())
+    if isinstance(plan, L.Project):
+        return P.ProjectExec(plan.exprs, plan_physical(plan.child))
+    if isinstance(plan, L.Filter):
+        return P.FilterExec(plan.condition, plan_physical(plan.child))
+    if isinstance(plan, L.Aggregate):
+        return P.HashAggregateExec(plan.groupings, plan.aggregates,
+                                   plan_physical(plan.child))
+    if isinstance(plan, L.Sort):
+        return P.SortExec(plan.orders, plan_physical(plan.child))
+    if isinstance(plan, L.Limit):
+        return P.LimitExec(plan.n, plan_physical(plan.child), plan.offset)
+    if isinstance(plan, L.Distinct):
+        cols = tuple(E.Col(n) for n in plan.schema.names)
+        return P.HashAggregateExec(cols, cols, plan_physical(plan.child))
+    if isinstance(plan, L.SubqueryAlias):
+        return plan_physical(plan.child)
+    if isinstance(plan, L.Repartition):
+        # single-device: a no-op; the mesh executor re-plans it as an
+        # exchange (parallel/exchange.py)
+        return plan_physical(plan.child)
+    if isinstance(plan, L.Sample):
+        return P.SampleExec(plan.fraction, plan.seed,
+                            plan_physical(plan.child),)
+    if isinstance(plan, L.Join):
+        return P.JoinExec(plan_physical(plan.left), plan_physical(plan.right),
+                          plan.how, plan.left_keys, plan.right_keys,
+                          plan.condition)
+    if isinstance(plan, L.Union):
+        return P.UnionExec(plan_physical(plan.left), plan_physical(plan.right))
+    raise NotImplementedError(f"no physical plan for {type(plan).__name__}")
+
+
+# ---- stage-fused execution --------------------------------------------------
+
+_STAGE_CACHE: Dict[tuple, tuple] = {}
+
+
+def _fully_traceable(plan: P.PhysicalPlan) -> bool:
+    if isinstance(plan, P.BatchScanExec):
+        return True
+    return plan.traceable and all(_fully_traceable(c) for c in plan.children())
+
+
+def _collect_scans(plan: P.PhysicalPlan, out: List[P.BatchScanExec]) -> None:
+    if isinstance(plan, P.BatchScanExec):
+        out.append(plan)
+        return
+    for c in plan.children():
+        _collect_scans(c, out)
+
+
+@dataclass(eq=False)
+class _ScanSlot(P.PhysicalPlan):
+    """Leaf placeholder in cached stage closures: carries only the scan
+    schema so cached jit functions never pin leaf device buffers."""
+
+    scan_schema: "object"
+    traceable = True
+
+    @property
+    def schema(self):
+        return self.scan_schema
+
+
+def _strip_leaf_data(plan: P.PhysicalPlan) -> P.PhysicalPlan:
+    if isinstance(plan, P.BatchScanExec):
+        return _ScanSlot(plan.batch.schema)
+    fields = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        fields[f.name] = _strip_leaf_data(v) if isinstance(
+            v, P.PhysicalPlan) else v
+    return dataclasses.replace(plan, **fields)
+
+
+def _run_fused(plan: P.PhysicalPlan) -> Batch:
+    """Compile a maximal traceable subtree to one XLA program and run it.
+    The jit cache is keyed on plan structure + leaf shapes/dictionaries
+    (analogue of CodeGenerator.compile's generated-class cache,
+    reference: codegen/CodeGenerator.scala:1442). Cached closures hold a
+    leaf-stripped plan skeleton — leaf batch data arrives as arguments."""
+    scans: List[P.BatchScanExec] = []
+    _collect_scans(plan, scans)
+    key = plan.plan_key()
+    entry = _STAGE_CACHE.get(key)
+    if entry is None:
+        schema_box: dict = {}
+        skeleton = _strip_leaf_data(plan)
+
+        def stage_fn(leaf_datas):
+            it = iter(leaf_datas)
+
+            def go(p: P.PhysicalPlan) -> P.Pipe:
+                if isinstance(p, _ScanSlot):
+                    return P.Pipe.from_batch_data(p.scan_schema, next(it))
+                pipes = [go(c) for c in p.children()]
+                return p.trace(pipes)
+
+            batch = go(skeleton).to_batch()
+            schema_box["schema"] = batch.schema
+            return batch.data
+
+        entry = (jax.jit(stage_fn), schema_box)
+        _STAGE_CACHE[key] = entry
+    jitted, schema_box = entry
+    data = jitted(tuple(s.batch.data for s in scans))
+    return Batch(schema_box["schema"], data)
+
+
+def _maybe_compact(batch: Batch) -> Batch:
+    """Shrink sparse batches between stages so capacities don't cascade
+    (the reference's equivalent pressure valve is AQE partition
+    coalescing, CoalesceShufflePartitions.scala)."""
+    cap = batch.capacity
+    if cap <= 4096:
+        return batch
+    live = int(np.asarray(batch.data.row_mask).sum())
+    if live * 4 > cap:
+        return batch
+    new_cap = K.bucket(live)
+    perm = K.compaction_permutation(batch.data.row_mask)
+    idx = perm[:new_cap]
+    from spark_tpu.columnar.batch import BatchData, ColumnData
+
+    cols = tuple(
+        ColumnData(cd.data[idx],
+                   None if cd.validity is None else cd.validity[idx])
+        for cd in batch.data.columns)
+    return Batch(batch.schema, BatchData(cols, batch.data.row_mask[idx]))
+
+
+def execute(plan: P.PhysicalPlan) -> Batch:
+    """Run a physical plan: fuse what we can, block where we must."""
+    if isinstance(plan, P.BatchScanExec):
+        return plan.batch
+    if _fully_traceable(plan):
+        return _run_fused(plan)
+    child_batches = []
+    for c in plan.children():
+        b = execute(c)
+        child_batches.append(_maybe_compact(b))
+    return plan.execute_blocking(child_batches)
+
+
+def execute_logical(plan: L.LogicalPlan, optimize: bool = True) -> Batch:
+    from spark_tpu.plan.optimizer import optimize as opt
+
+    lp = opt(plan) if optimize else plan
+    return execute(plan_physical(lp))

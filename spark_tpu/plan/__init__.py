@@ -1,0 +1,1 @@
+from spark_tpu.plan import logical, optimizer  # noqa: F401

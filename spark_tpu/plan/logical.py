@@ -1,0 +1,405 @@
+"""Logical plans.
+
+The analogue of Catalyst's logical operators (reference:
+sql/catalyst/src/main/scala/org/apache/spark/sql/catalyst/plans/logical/
+basicLogicalOperators.scala) plus the TreeNode transform machinery
+(reference: catalyst/trees/TreeNode.scala). Nodes are immutable
+dataclasses; ``schema`` resolves output types bottom-up, which folds the
+analyzer's resolution role (reference: analysis/Analyzer.scala:188) into
+plan construction — the DataFrame API builds resolved plans directly,
+and the SQL parser resolves names against child schemas as it builds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import cached_property
+from typing import Any, Callable, Optional, Tuple
+
+from spark_tpu import types as T
+from spark_tpu.expr import expressions as E
+from spark_tpu.types import Field, Schema
+
+
+class LogicalPlan:
+    """Base class; subclasses are frozen dataclasses."""
+
+    def children(self) -> Tuple["LogicalPlan", ...]:
+        return ()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def with_children(self, children: Tuple["LogicalPlan", ...]) -> "LogicalPlan":
+        """Rebuild this node with new children (positional)."""
+        if not children:
+            return self
+        fields = {}
+        it = iter(children)
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, LogicalPlan):
+                fields[f.name] = next(it)
+            else:
+                fields[f.name] = v
+        return dataclasses.replace(self, **fields)
+
+    def transform_up(self, fn: Callable[["LogicalPlan"], "LogicalPlan"]) -> "LogicalPlan":
+        new_children = tuple(c.transform_up(fn) for c in self.children())
+        node = self.with_children(new_children) if new_children else self
+        return fn(node)
+
+    def transform_expressions(self, fn) -> "LogicalPlan":
+        """Apply an expression transform to every expression in this node."""
+        fields = {}
+        changed = False
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            nv = _transform_value(v, fn)
+            changed |= nv is not v
+            fields[f.name] = nv
+        return dataclasses.replace(self, **fields) if changed else self
+
+    def expressions(self) -> Tuple[E.Expression, ...]:
+        out = []
+        for f in dataclasses.fields(self):
+            _collect_exprs(getattr(self, f.name), out)
+        return tuple(out)
+
+    def references(self) -> set:
+        refs = set()
+        for e in self.expressions():
+            refs |= e.references()
+        return refs
+
+    def tree_string(self, indent: int = 0) -> str:
+        line = "  " * indent + self.node_string()
+        return "\n".join([line] + [c.tree_string(indent + 1)
+                                   for c in self.children()])
+
+    def node_string(self) -> str:
+        return type(self).__name__
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+def _transform_value(v, fn):
+    if isinstance(v, E.Expression):
+        return E.transform_expr(v, fn)
+    if isinstance(v, tuple):
+        nv = tuple(_transform_value(x, fn) for x in v)
+        return nv if any(a is not b for a, b in zip(nv, v)) else v
+    return v
+
+
+def _collect_exprs(v, out: list) -> None:
+    if isinstance(v, E.Expression):
+        out.append(v)
+    elif isinstance(v, tuple):
+        for x in v:
+            _collect_exprs(x, out)
+
+
+# ---- leaves ----------------------------------------------------------------
+
+
+@dataclass(eq=False, frozen=True)
+class Relation(LogicalPlan):
+    """In-memory relation over an already-built device Batch (analogue of
+    LocalRelation, reference: catalyst/plans/logical/LocalRelation.scala)."""
+
+    batch: Any  # columnar.batch.Batch
+    name: Optional[str] = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.batch.schema
+
+    def node_string(self):
+        return f"Relation{list(self.schema.names)}"
+
+
+@dataclass(eq=False, frozen=True)
+class Range(LogicalPlan):
+    """spark.range(start, end, step) (reference: basicLogicalOperators
+    Range + RangeExec basicPhysicalOperators.scala:412). Generated
+    on-device as iota — no host data."""
+
+    start: int
+    end: int
+    step: int = 1
+    col_name: str = "id"
+
+    @property
+    def schema(self) -> Schema:
+        return Schema((Field(self.col_name, T.INT64, nullable=False),))
+
+    @property
+    def num_rows(self) -> int:
+        if self.step == 0:
+            return 0
+        n = (self.end - self.start + self.step - (1 if self.step > 0 else -1))
+        return max(0, n // self.step)
+
+    def node_string(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+@dataclass(eq=False, frozen=True)
+class UnresolvedScan(LogicalPlan):
+    """A named table / file source resolved by the session catalog at
+    physical planning time (DSv2 Scan analogue)."""
+
+    source: Any  # io datasource object with .schema and .read()
+    options: Tuple[Tuple[str, str], ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        return self.source.schema
+
+    def node_string(self):
+        return f"Scan({self.source})"
+
+
+# ---- unary -----------------------------------------------------------------
+
+
+@dataclass(eq=False, frozen=True)
+class Project(LogicalPlan):
+    exprs: Tuple[E.Expression, ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @cached_property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = []
+        for e in self.exprs:
+            dt = e.data_type(cs)
+            inner = E.strip_alias(e)
+            dictionary = None
+            if isinstance(inner, E.Col) and inner.col_name in cs:
+                dictionary = cs.field(inner.col_name).dictionary
+            fields.append(Field(e.name, dt, e.nullable(cs), dictionary))
+        return Schema(tuple(fields))
+
+    def node_string(self):
+        return f"Project[{', '.join(str(e) for e in self.exprs)}]"
+
+
+@dataclass(eq=False, frozen=True)
+class Filter(LogicalPlan):
+    condition: E.Expression
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def node_string(self):
+        return f"Filter[{self.condition}]"
+
+
+@dataclass(eq=False, frozen=True)
+class Aggregate(LogicalPlan):
+    """GROUP BY. ``groupings`` are key expressions; ``aggregates`` are the
+    output expressions (may mix keys and aggregate functions), matching
+    the reference (plans/logical/basicLogicalOperators.scala Aggregate)."""
+
+    groupings: Tuple[E.Expression, ...]
+    aggregates: Tuple[E.Expression, ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @cached_property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = []
+        for e in self.aggregates:
+            dt = e.data_type(cs)
+            inner = E.strip_alias(e)
+            dictionary = None
+            if isinstance(inner, E.Col) and inner.col_name in cs:
+                dictionary = cs.field(inner.col_name).dictionary
+            elif isinstance(inner, (E.Min, E.Max, E.First)):
+                c = E.strip_alias(inner.child)
+                if isinstance(c, E.Col) and c.col_name in cs:
+                    dictionary = cs.field(c.col_name).dictionary
+            fields.append(Field(e.name, dt, e.nullable(cs), dictionary))
+        return Schema(tuple(fields))
+
+    def node_string(self):
+        return (f"Aggregate[keys=[{', '.join(map(str, self.groupings))}], "
+                f"out=[{', '.join(str(e) for e in self.aggregates)}]]")
+
+
+@dataclass(eq=False, frozen=True)
+class Sort(LogicalPlan):
+    orders: Tuple[E.SortOrder, ...]
+    child: LogicalPlan
+    is_global: bool = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def node_string(self):
+        return f"Sort[{', '.join(map(str, self.orders))}]"
+
+
+@dataclass(eq=False, frozen=True)
+class Limit(LogicalPlan):
+    n: int
+    child: LogicalPlan
+    offset: int = 0
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def node_string(self):
+        return f"Limit[{self.n}]"
+
+
+@dataclass(eq=False, frozen=True)
+class Distinct(LogicalPlan):
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+@dataclass(eq=False, frozen=True)
+class SubqueryAlias(LogicalPlan):
+    alias: str
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def node_string(self):
+        return f"SubqueryAlias[{self.alias}]"
+
+
+@dataclass(eq=False, frozen=True)
+class Repartition(LogicalPlan):
+    """repartition(n) / repartition(cols) — an explicit exchange request
+    (reference: plans/logical/basicLogicalOperators.scala Repartition +
+    RepartitionByExpression)."""
+
+    num_partitions: int
+    keys: Tuple[E.Expression, ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+@dataclass(eq=False, frozen=True)
+class Sample(LogicalPlan):
+    fraction: float
+    seed: int
+    child: LogicalPlan
+    with_replacement: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+# ---- binary ----------------------------------------------------------------
+
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+
+
+@dataclass(eq=False, frozen=True)
+class Join(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    how: str  # one of JOIN_TYPES
+    # Equi-join keys (left_keys[i] == right_keys[i]); extra non-equi
+    # predicates go to ``condition`` and are applied post-match.
+    left_keys: Tuple[E.Expression, ...]
+    right_keys: Tuple[E.Expression, ...]
+    condition: Optional[E.Expression] = None
+
+    def children(self):
+        return (self.left, self.right)
+
+    @cached_property
+    def schema(self) -> Schema:
+        if self.how == "left_semi" or self.how == "left_anti":
+            return self.left.schema
+        lf = list(self.left.schema.fields)
+        rf = list(self.right.schema.fields)
+        if self.how in ("left", "full"):
+            rf = [dataclasses.replace(f, nullable=True) for f in rf]
+        if self.how in ("right", "full"):
+            lf = [dataclasses.replace(f, nullable=True) for f in lf]
+        # duplicate names get a '#2' suffix (must match JoinExec.schema)
+        seen = set()
+        out = []
+        for f in lf + rf:
+            name = f.name
+            while name in seen:
+                name = name + "#2"
+            seen.add(name)
+            out.append(dataclasses.replace(f, name=name))
+        return Schema(tuple(out))
+
+    def node_string(self):
+        ks = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
+        return f"Join[{self.how}, keys=({ks}), cond={self.condition}]"
+
+
+@dataclass(eq=False, frozen=True)
+class Union(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def schema(self) -> Schema:
+        # Column names/types come from the left (Spark semantics).
+        return self.left.schema
+
+
+# ---- helpers ---------------------------------------------------------------
+
+
+def resolve_star(plan: LogicalPlan) -> Tuple[E.Expression, ...]:
+    """Expand `*` against a plan's schema."""
+    return tuple(E.Col(n) for n in plan.schema.names)

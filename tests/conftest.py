@@ -1,11 +1,15 @@
 """Test harness: a 'local-mesh' analogue of the reference's local[N] /
 local-cluster[n,c,m] master URLs (reference: SparkContext master parsing;
 LocalSparkCluster.scala) — 8 virtual CPU devices so distributed paths are
-exercised without TPU hardware (SURVEY.md §4 'Lesson for the TPU build')."""
+exercised without TPU hardware (SURVEY.md §4 'Lesson for the TPU build').
+
+Note: the axon sitecustomize force-registers the TPU backend and
+overwrites JAX_PLATFORMS, so forcing CPU must go through jax.config
+AFTER import, not the environment.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,6 +17,7 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,199 @@
+"""DataFrame API tests (model: reference sql/core DataFrameSuite.scala,
+DataFrameAggregateSuite.scala, DataFrameJoinSuite.scala + python
+pyspark/sql/tests/test_dataframe.py)."""
+
+import datetime
+
+import pytest
+
+from spark_tpu.api import functions as F
+from spark_tpu.expr import expressions as E
+
+
+@pytest.fixture(scope="module")
+def people(spark):
+    return spark.createDataFrame([
+        {"name": "alice", "dept": "eng", "salary": 100, "age": 30},
+        {"name": "bob", "dept": "eng", "salary": 200, "age": 40},
+        {"name": "carol", "dept": "ops", "salary": 150, "age": None},
+        {"name": "dave", "dept": "ops", "salary": 50, "age": 25},
+        {"name": "erin", "dept": "sales", "salary": 300, "age": 35},
+    ])
+
+
+def test_select_filter(people):
+    rows = (people.filter(F.col("salary") > 100)
+            .select("name", (F.col("salary") * 2).alias("s2"))
+            .orderBy("name").collect())
+    assert [(r.name, r.s2) for r in rows] == [
+        ("bob", 400), ("carol", 300), ("erin", 600)]
+
+
+def test_filter_string_condition(people):
+    assert people.filter(F.col("dept") == "eng").count() == 2
+
+
+def test_groupby_agg(people):
+    rows = (people.groupBy("dept")
+            .agg(F.sum("salary").alias("total"),
+                 F.avg("salary").alias("mean"),
+                 F.count().alias("n"),
+                 F.max("name").alias("mx"))
+            .orderBy("dept").collect())
+    assert [(r.dept, r.total, r.mean, r.n, r.mx) for r in rows] == [
+        ("eng", 300, 150.0, 2, "bob"),
+        ("ops", 200, 100.0, 2, "dave"),
+        ("sales", 300, 300.0, 1, "erin"),
+    ]
+
+
+def test_agg_nulls(people):
+    row = people.agg(F.count("age").alias("c"),
+                     F.avg("age").alias("a"),
+                     F.min("age").alias("mn")).collect()[0]
+    assert row.c == 4
+    assert row.a == pytest.approx((30 + 40 + 25 + 35) / 4)
+    assert row.mn == 25
+
+
+def test_global_agg_no_group(people):
+    row = people.agg(F.sum("salary").alias("s")).collect()[0]
+    assert row.s == 800
+
+
+def test_withcolumn_drop_rename(people):
+    df = (people.withColumn("double", F.col("salary") * 2)
+          .withColumnRenamed("name", "who")
+          .drop("dept", "age"))
+    assert df.columns == ["who", "salary", "double"]
+    top = df.orderBy(F.desc("double")).first()
+    assert top.who == "erin" and top.double == 600
+
+
+def test_distinct_dropduplicates(spark):
+    df = spark.createDataFrame([
+        {"a": 1, "b": "x"}, {"a": 1, "b": "x"}, {"a": 2, "b": "y"},
+    ])
+    assert df.distinct().count() == 2
+    assert df.dropDuplicates(["a"]).count() == 2
+
+
+def test_sort_nulls(people):
+    names = [r.name for r in people.orderBy(F.col("age").asc()).collect()]
+    assert names[0] == "carol"  # NULLS FIRST for ASC (Spark default)
+    names = [r.name for r in people.orderBy(F.desc("age")).collect()]
+    assert names[-1] == "carol"  # NULLS LAST for DESC
+
+
+def test_limit_offset(people):
+    rows = people.orderBy("salary").limit(2).collect()
+    assert [r.name for r in rows] == ["dave", "alice"]
+
+
+def test_union(spark, people):
+    more = spark.createDataFrame(
+        [{"name": "zed", "dept": "eng", "salary": 10, "age": 20}])
+    assert people.union(more).count() == 6
+
+
+def test_joins(spark, people):
+    depts = spark.createDataFrame([
+        {"dept": "eng", "floor": 1},
+        {"dept": "ops", "floor": 2},
+        {"dept": "hr", "floor": 3},
+    ])
+    inner = people.join(depts, on="dept").orderBy("name")
+    assert [(r.name, r.floor) for r in inner.collect()] == [
+        ("alice", 1), ("bob", 1), ("carol", 2), ("dave", 2)]
+    left = people.join(depts, on="dept", how="left").orderBy("name")
+    assert [r.floor for r in left.collect()] == [1, 1, 2, 2, None]
+    semi = people.join(depts, on="dept", how="left_semi")
+    assert semi.count() == 4
+    anti = people.join(depts, on="dept", how="left_anti")
+    assert [r.name for r in anti.collect()] == ["erin"]
+
+
+def test_join_expr_condition(spark):
+    l = spark.createDataFrame([{"k": 1, "v": 10}, {"k": 2, "v": 20}])
+    r = spark.createDataFrame([{"k2": 1, "w": 5}, {"k2": 1, "w": 50},
+                               {"k2": 2, "w": 7}])
+    j = l.join(r, on=(F.col("k") == F.col("k2")) & (F.col("w") > F.col("v") - 10))
+    rows = sorted([(x.k, x.w) for x in j.collect()])
+    assert rows == [(1, 5), (1, 50), (2, 20)] or rows == [(1, 5), (1, 50)]
+    # v=10: w>0 -> both 5 and 50 match; v=20: w>10 -> no (7 fails)
+    assert (1, 5) in rows and (1, 50) in rows and (2, 7) not in rows
+
+
+def test_when_otherwise(people):
+    rows = (people.select(
+        "name",
+        F.when(F.col("salary") >= 200, "high")
+         .when(F.col("salary") >= 100, "mid")
+         .otherwise("low").alias("band"))
+        .orderBy("name").collect())
+    assert [r.band for r in rows] == ["mid", "high", "mid", "low", "high"]
+
+
+def test_range(spark):
+    assert spark.range(10).count() == 10
+    assert spark.range(2, 10, 3).count() == 3
+    row = spark.range(100).agg(F.sum("id").alias("s")).collect()[0]
+    assert row.s == 4950
+
+
+def test_cross_join(spark):
+    a = spark.createDataFrame([{"x": 1}, {"x": 2}])
+    b = spark.createDataFrame([{"y": 10}, {"y": 20}, {"y": 30}])
+    assert a.crossJoin(b).count() == 6
+
+
+def test_temp_view_and_table(spark, people):
+    people.createOrReplaceTempView("people")
+    assert spark.catalog.tableExists("people")
+    assert spark.table("people").count() == 5
+
+
+def test_cache(people):
+    c = people.cache()
+    assert c.count() == 5
+    assert c.groupBy("dept").count().count() == 3
+
+
+def test_stddev(spark):
+    df = spark.createDataFrame([{"x": float(v)} for v in [2, 4, 4, 4, 5, 5, 7, 9]])
+    row = df.agg(F.stddev_pop("x").alias("sp"),
+                 F.stddev("x").alias("ss"),
+                 F.var_pop("x").alias("vp")).collect()[0]
+    assert row.sp == pytest.approx(2.0)
+    assert row.vp == pytest.approx(4.0)
+    assert row.ss == pytest.approx(2.138089935299395)
+
+
+def test_dates(spark):
+    d = datetime.date
+    df = spark.createDataFrame([
+        {"d": d(2024, 1, 31), "v": 1},
+        {"d": d(2024, 3, 1), "v": 2},
+    ])
+    rows = (df.select(F.year("d").alias("y"), F.month("d").alias("m"),
+                      F.dayofmonth("d").alias("dd"),
+                      F.add_months("d", 1).alias("plus"))
+            .orderBy("m").collect())
+    assert (rows[0].y, rows[0].m, rows[0].dd) == (2024, 1, 31)
+    assert rows[0].plus == d(2024, 2, 29)  # leap-year clamp
+    assert rows[1].plus == d(2024, 4, 1)
+    assert df.filter(F.col("d") >= d(2024, 2, 1)).count() == 1
+
+
+def test_sort_multi_key(spark):
+    df = spark.createDataFrame([
+        {"a": 1, "b": 2}, {"a": 1, "b": 1}, {"a": 0, "b": 9},
+    ])
+    rows = df.orderBy(F.col("a").asc(), F.desc("b")).collect()
+    assert [(r.a, r.b) for r in rows] == [(0, 9), (1, 2), (1, 1)]
+
+
+def test_show_runs(people, capsys):
+    people.show()
+    out = capsys.readouterr().out
+    assert "alice" in out and "+" in out

@@ -1,0 +1,67 @@
+"""Join edge cases flagged by review: empty sides, full outer, duplicate
+names (model: reference sql/core JoinSuite.scala / OuterJoinSuite.scala)."""
+
+import pytest
+
+from spark_tpu.api import functions as F
+
+
+@pytest.fixture(scope="module")
+def lr(spark):
+    l = spark.createDataFrame([{"k": 1, "v": 10}, {"k": 2, "v": 20},
+                               {"k": 5, "v": 50}])
+    r = spark.createDataFrame([{"k": 1, "w": 100}, {"k": 3, "w": 300}])
+    return l, r
+
+
+def test_full_outer(lr):
+    l, r = lr
+    rows = l.join(r, on="k", how="full").orderBy("k").collect()
+    got = [(x.k, x.v, x.w) for x in rows]
+    assert got == [(1, 10, 100), (2, 20, None), (3, None, 300), (5, 50, None)]
+
+
+def test_right_outer(lr):
+    l, r = lr
+    rows = l.join(r, on="k", how="right").orderBy("k").collect()
+    assert [(x.k, x.v, x.w) for x in rows] == [(1, 10, 100), (3, None, 300)]
+
+
+def test_cross_join_empty_right(spark):
+    a = spark.createDataFrame([{"x": 1}, {"x": 2}])
+    b = spark.createDataFrame([{"y": 10}]).filter(F.col("y") > 100)
+    assert a.crossJoin(b).count() == 0
+    assert a.crossJoin(b).collect() == []
+
+
+def test_join_empty_build(spark, lr):
+    l, _ = lr
+    empty = spark.createDataFrame([{"k": 9, "w": 9}]).filter(F.col("w") < 0)
+    assert l.join(empty, on="k").count() == 0
+    assert l.join(empty, on="k", how="left").count() == 3
+    assert l.join(empty, on="k", how="left_anti").count() == 3
+
+
+def test_unfinished_when_chain(spark):
+    df = spark.createDataFrame([{"v": 10}, {"v": 20}])
+    rows = (df.select(F.when(F.col("v") > 15, "big").alias("band"))
+            .orderBy("band").collect())
+    assert sorted([r.band for r in rows], key=lambda x: (x is None, x)) \
+        == ["big", None]
+
+
+def test_duplicate_column_names_join(spark):
+    a = spark.createDataFrame([{"k": 1, "v": 1}])
+    b = spark.createDataFrame([{"k": 1, "v": 2}])
+    j = a.join(b, on="k")
+    assert j.columns == ["k", "v", "v#2"]
+    row = j.collect()[0]
+    assert row["v"] == 1 and row["v#2"] == 2
+
+
+def test_null_keys_never_match(spark):
+    a = spark.createDataFrame([{"k": 1, "v": 1}, {"k": None, "v": 2}])
+    b = spark.createDataFrame([{"k": 1, "w": 3}, {"k": None, "w": 4}])
+    assert a.join(b, on="k").count() == 1  # SQL: NULL != NULL
+    left = a.join(b, on="k", how="left").orderBy("v").collect()
+    assert [(r.v, r.w) for r in left] == [(1, 3), (2, None)]
