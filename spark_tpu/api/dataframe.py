@@ -269,6 +269,10 @@ class DataFrame:
     # ---- actions -----------------------------------------------------------
 
     def _execute(self):
+        ex = getattr(self._session, "mesh_executor", None) \
+            if self._session is not None else None
+        if ex is not None:
+            return ex.execute_logical(self._plan)
         from spark_tpu.physical.planner import execute_logical
 
         return execute_logical(self._plan)
@@ -287,9 +291,7 @@ class DataFrame:
 
     def count(self) -> int:
         agg = L.Aggregate((), (E.Alias(E.Count(None), "count"),), self._plan)
-        from spark_tpu.physical.planner import execute_logical
-
-        batch = execute_logical(agg)
+        batch = self._with(agg)._execute()
         return int(batch.to_pylist()[0]["count"])
 
     def first(self) -> Optional[Row]:

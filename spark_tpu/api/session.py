@@ -57,8 +57,17 @@ class SparkSessionBuilder:
         self._app_name = name
         return self
 
-    def master(self, _url: str) -> "SparkSessionBuilder":
-        return self  # the mesh IS the cluster
+    def master(self, url: str) -> "SparkSessionBuilder":
+        """master URL analogue (reference: SparkContext master parsing):
+        ``local`` / ``local[*]`` = single-device; ``mesh[N]`` = SPMD
+        execution over an N-device jax mesh (the cluster IS the mesh)."""
+        if url.startswith("mesh"):
+            n = None
+            if "[" in url:
+                inner = url[url.index("[") + 1:url.index("]")]
+                n = None if inner == "*" else int(inner)
+            self._conf["spark_tpu.mesh.devices"] = n if n is not None else -1
+        return self
 
     def config(self, key: str, value: Any) -> "SparkSessionBuilder":
         self._conf[key] = value
@@ -86,6 +95,24 @@ class SparkSession:
         self.conf = RuntimeConf(conf)
         self.catalog = Catalog(self)
         self._read = None
+        self._mesh = None
+        self._mesh_executor = None
+        n = self.conf.entries().get("spark_tpu.mesh.devices")
+        if n is not None:
+            from spark_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(None if n == -1 else int(n))
+
+    @property
+    def mesh_executor(self):
+        """Distributed executor when running under a mesh master URL."""
+        if self._mesh is None:
+            return None
+        if self._mesh_executor is None:
+            from spark_tpu.parallel.executor import MeshExecutor
+
+            self._mesh_executor = MeshExecutor(self._mesh)
+        return self._mesh_executor
 
     # -- builder is reset-safe for tests
     @classmethod

@@ -64,7 +64,7 @@ class Batch:
     executor passes between stages. Thin — all compute goes through the
     physical operators, which consume (schema, data) and are jitted."""
 
-    __slots__ = ("schema", "data")
+    __slots__ = ("schema", "data", "__weakref__")
 
     def __init__(self, schema: Schema, data: BatchData):
         assert len(schema) == len(data.columns), (

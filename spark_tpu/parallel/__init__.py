@@ -1,0 +1,15 @@
+"""Mesh-distributed execution.
+
+This package replaces the reference's entire distributed runtime — the
+driver/executor split, Netty RPC, and the sort-based shuffle machinery
+(reference: core/.../scheduler/DAGScheduler.scala:121,
+shuffle/sort/SortShuffleManager.scala:73, rpc/netty/NettyRpcEnv.scala:45,
+network-common) — with the TPU-native shape: data lives sharded over a
+`jax.sharding.Mesh`, a "stage" is one pjit/shard_map-compiled SPMD
+program, and "shuffle" is an in-HBM `all_to_all` over ICI instead of
+sorted spill files fetched over TCP (SURVEY.md §2 "Distributed
+communication backend", §7 design stance).
+"""
+
+from spark_tpu.parallel.mesh import DATA_AXIS, make_mesh  # noqa: F401
+from spark_tpu.parallel.sharded import ShardedBatch  # noqa: F401

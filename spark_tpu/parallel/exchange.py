@@ -1,0 +1,170 @@
+"""Exchange primitives — shuffle as ICI collectives.
+
+Everything here runs INSIDE a shard_map trace (one device's view, with
+the ``data`` axis name in scope). This file is the whole replacement for
+the reference's shuffle write/fetch pipeline: sort-based spill files +
+Netty chunk fetch (reference: shuffle/sort/SortShuffleManager.scala:73,
+UnsafeShuffleWriter.java:173, storage/ShuffleBlockFetcherIterator.scala:86,
+common/network-common) becomes: bucket rows into a (D, cap) send tensor
+and `lax.all_to_all` it over the interconnect. No files, no serializer,
+no fetch scheduler — the collective IS the shuffle.
+
+Static-shape contract: the receive capacity is D * send_capacity (worst
+case: everyone routes everything to one device). AQE-style stats can
+shrink this between stages (planner._maybe_compact analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_tpu import types as T
+from spark_tpu.expr import compiler as C
+from spark_tpu.expr.compiler import TV
+from spark_tpu.parallel.mesh import DATA_AXIS
+from spark_tpu.physical import kernels as K
+from spark_tpu.physical.operators import Pipe
+
+
+def axis_index() -> jnp.ndarray:
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def axis_size() -> int:
+    return jax.lax.axis_size(DATA_AXIS)
+
+
+# ---- row routing ------------------------------------------------------------
+
+
+def hash_target(tvs: Sequence[TV], mask: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Device id per row = avalanche hash of the key columns mod D
+    (HashPartitioning analogue, reference:
+    exchange/ShuffleExchangeExec.scala:275). Dictionary codes hash
+    directly — dictionaries are global constants, so codes agree across
+    devices. NULL hashes as a fixed sentinel, so null keys co-locate."""
+    cap = int(mask.shape[0])
+    h = jnp.zeros((cap,), dtype=jnp.uint64)
+    for tv in tvs:
+        data = tv.data
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            # normalize -0.0 == 0.0 before bitcasting
+            data = jax.lax.bitcast_convert_type(
+                jnp.where(data == 0, 0.0, data).astype(jnp.float64),
+                jnp.uint64)
+        code = data.astype(jnp.uint64)
+        if tv.validity is not None:
+            code = jnp.where(tv.validity, code,
+                             jnp.uint64(0xA5A5A5A5A5A5A5A5))
+        h = K.hash_combine(h, code)
+    return (h % jnp.uint64(d)).astype(jnp.int32)
+
+
+def range_target(key: TV, ascending: bool, nulls_first: bool, d: int,
+                 mask: jnp.ndarray,
+                 samples_per_device: int = 128) -> jnp.ndarray:
+    """Device id per row for range partitioning: sample local keys,
+    all_gather the samples, cut D-1 splitters — every device derives the
+    SAME splitters, so no separate sampling job is needed (reference
+    needs one: RangePartitioner sketch job,
+    core/.../Partitioner.scala + ShuffleExchangeExec.scala:280)."""
+    rank_table = None
+    if isinstance(key.dtype, T.StringType):
+        rank_table = C.string_rank_table(key.dictionary or ())
+    y = K.orderable_int64(key.data, key.validity, ascending, nulls_first,
+                          rank_table)
+    cap = int(mask.shape[0])
+    imax = jnp.iinfo(jnp.int64).max
+    ys = jnp.sort(jnp.where(mask, y, imax))
+    # spread samples over the live prefix; dead rows sample as +inf and
+    # only skew splitters when occupancy is very low (AQE re-split later)
+    s = min(samples_per_device, cap)
+    idx = (jnp.arange(s) * cap) // s
+    samples = ys[idx]
+    all_samples = jnp.sort(jax.lax.all_gather(samples, DATA_AXIS,
+                                              tiled=True))
+    total = int(all_samples.shape[0])
+    cut_pos = (jnp.arange(1, d) * total) // d
+    splitters = all_samples[cut_pos]
+    return jnp.searchsorted(splitters, y, side="right").astype(jnp.int32)
+
+
+# ---- the collective exchange ------------------------------------------------
+
+
+def exchange(pipe: Pipe, target: jnp.ndarray) -> Pipe:
+    """Route each live row to device ``target[row]``. Local capacity cap
+    becomes D*cap after the all_to_all. One fused sequence:
+    sort-by-destination -> scatter into (D, cap) send buffer ->
+    all_to_all over ICI -> flatten."""
+    d = axis_size()
+    cap = pipe.capacity
+    live = pipe.mask
+    t = jnp.where(live, jnp.clip(target, 0, d - 1), d)  # dead rows -> sentinel
+    order = jnp.argsort(t, stable=True)
+    st = t[order]
+    starts = jnp.searchsorted(st, jnp.arange(d), side="left")
+    pos = jnp.arange(cap) - starts[jnp.clip(st, 0, d - 1)]
+    # destination slot in the (D, cap) buffer; sentinel rows -> OOB drop
+    dest = jnp.where(st < d, st * cap + pos, d * cap)
+
+    def route(x: jnp.ndarray, fill) -> jnp.ndarray:
+        buf = jnp.full((d * cap,), fill, dtype=x.dtype)
+        buf = buf.at[dest].set(x[order], mode="drop")
+        return jax.lax.all_to_all(buf.reshape(d, cap), DATA_AXIS, 0, 0,
+                                  tiled=True).reshape(-1)
+
+    new_mask = route(live, False)
+    cols: Dict[str, TV] = {}
+    for name in pipe.order:
+        tv = pipe.cols[name]
+        data = route(tv.data, jnp.zeros((), tv.data.dtype))
+        validity = None if tv.validity is None else route(tv.validity, False)
+        cols[name] = TV(data, validity, tv.dtype, tv.dictionary)
+    return Pipe(cols, new_mask, pipe.order)
+
+
+def broadcast_gather(pipe: Pipe) -> Pipe:
+    """Replicate a (small) pipe onto every device via all_gather — the
+    broadcast-exchange data plane (reference: TorrentBroadcast.scala:59 +
+    BroadcastExchangeExec.scala:78; one ICI all_gather replaces the
+    BitTorrent chunk protocol)."""
+    def g(x):
+        return jax.lax.all_gather(x, DATA_AXIS, tiled=True)
+
+    cols = {
+        name: TV(g(tv.data),
+                 None if tv.validity is None else g(tv.validity),
+                 tv.dtype, tv.dictionary)
+        for name, tv in pipe.cols.items()
+    }
+    return Pipe(cols, g(pipe.mask), pipe.order)
+
+
+def to_single_partition(pipe: Pipe) -> Pipe:
+    """All rows to device 0 (SinglePartition analogue, reference:
+    ShuffleExchangeExec.scala:301): gather + mask off non-zero devices.
+    Row order across devices is preserved by the tiled gather."""
+    g = broadcast_gather(pipe)
+    on_zero = jnp.where(axis_index() == 0, g.mask,
+                        jnp.zeros_like(g.mask))
+    return Pipe(g.cols, on_zero, g.order)
+
+
+# ---- merged (cross-device) aggregation primitives ---------------------------
+
+
+def psum(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.psum(x, DATA_AXIS)
+
+
+def pmin(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.pmin(x, DATA_AXIS)
+
+
+def pmax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.pmax(x, DATA_AXIS)

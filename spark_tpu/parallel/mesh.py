@@ -1,0 +1,40 @@
+"""Device mesh construction.
+
+The mesh plays the role of the reference's cluster topology (executors
+registered with the driver, reference:
+core/.../cluster/CoarseGrainedSchedulerBackend.scala:53) — except
+membership is static for a program and agreed on by construction, so
+there is no registration protocol, heartbeat, or executor bookkeeping to
+rebuild. One mesh axis, ``data``, carries partition parallelism (the
+analogue of Spark task slots); further axes can be added for model-style
+parallelism without touching the exchange layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a 1-D ``data`` mesh over the first ``n_devices`` devices
+    (defaults to all). The local[N] / mesh[N] master-URL analogue."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"mesh[{n_devices}] requested but only {len(devices)} "
+                f"devices are available")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def mesh_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]

@@ -1,0 +1,777 @@
+"""Distributed physical operators (traced inside shard_map).
+
+These compose with the single-device operators (physical/operators.py) in
+ONE fused SPMD program per stage: local pipeline work is the same trace
+code, and cross-device redistribution appears as exchange collectives at
+exactly the points where the reference plants ShuffleExchangeExec /
+BroadcastExchangeExec nodes (reference: exchange/EnsureRequirements.scala:49,
+ShuffleExchangeExec.scala:120, BroadcastExchangeExec.scala:78). A whole
+distributed stage — scan, filter, partial agg, psum merge, final agg —
+compiles to a single XLA executable with collectives scheduled on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_tpu import types as T
+from spark_tpu.expr import compiler as C
+from spark_tpu.expr import expressions as E
+from spark_tpu.expr.compiler import Env, TV
+from spark_tpu.parallel import exchange as X
+from spark_tpu.parallel.sharded import ShardedBatch
+from spark_tpu.physical import kernels as K
+from spark_tpu.physical import operators as P
+from spark_tpu.physical.operators import Pipe, rewrite_agg_outputs
+from spark_tpu.types import Field, Schema
+
+
+@dataclass(eq=False)
+class ShardScanExec(P.PhysicalPlan):
+    """Leaf: a materialized ShardedBatch; the stage runner feeds each
+    device its local slice."""
+
+    sharded: ShardedBatch
+    traceable = True
+
+    @property
+    def schema(self) -> Schema:
+        return self.sharded.schema
+
+    def node_string(self):
+        return f"ShardScan{list(self.schema.names)}"
+
+    def plan_key(self):
+        dicts = tuple(f.dictionary for f in self.schema.fields)
+        return ("ShardScan", self.sharded.per_device_capacity,
+                tuple((f.name, repr(f.dtype)) for f in self.schema.fields),
+                hash(dicts))
+
+
+@dataclass(eq=False)
+class DistRangeExec(P.PhysicalPlan):
+    """range() generated directly sharded: device d materializes global
+    positions [d*p, (d+1)*p) — nothing is ever resident on one device
+    (reference RangeExec:412 splits by numSlices; here the mesh is the
+    slicing)."""
+
+    start: int
+    end: int
+    step: int
+    num_rows: int
+    per_device: int
+    col_name: str = "id"
+    traceable = True
+
+    @property
+    def schema(self) -> Schema:
+        return Schema((Field(self.col_name, T.INT64, nullable=False),))
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        p = self.per_device
+        gpos = X.axis_index().astype(jnp.int64) * p + jnp.arange(
+            p, dtype=jnp.int64)
+        ids = self.start + gpos * self.step
+        mask = gpos < self.num_rows
+        return Pipe({self.col_name: TV(ids, None, T.INT64, None)}, mask,
+                    [self.col_name])
+
+    def plan_key(self):
+        return ("DistRange", self.start, self.end, self.step, self.num_rows,
+                self.per_device, self.col_name)
+
+
+# ---- exchanges --------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class HashPartitionExchangeExec(P.PhysicalPlan):
+    """``key_union_dicts`` (optional, per key): a unified string
+    dictionary; codes translate through it before hashing so that two
+    relations with different dictionaries route equal strings to the
+    same device."""
+
+    keys: Tuple[E.Expression, ...]
+    child: P.PhysicalPlan
+    key_union_dicts: Optional[Tuple[Optional[Tuple[str, ...]], ...]] = None
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        d = X.axis_size()
+        env = pipe.env()
+        tvs = [C.evaluate(k, env) for k in self.keys]
+        if self.key_union_dicts is not None:
+            translated = []
+            for tv, union in zip(tvs, self.key_union_dicts):
+                if union is not None and tv.dictionary is not None:
+                    pos = {s: i for i, s in enumerate(union)}
+                    table = np.array([pos[s] for s in tv.dictionary],
+                                     dtype=np.int64)
+                    tv = TV(jnp.asarray(table)[tv.data], tv.validity,
+                            tv.dtype, union)
+                translated.append(tv)
+            tvs = translated
+        target = X.hash_target(tvs, pipe.mask, d)
+        return X.exchange(pipe, target)
+
+    def node_string(self):
+        return f"Exchange[hash({', '.join(map(str, self.keys))})]"
+
+    def plan_key(self):
+        return ("HashExchange", tuple(E.expr_key(k) for k in self.keys),
+                self.key_union_dicts, self.child.plan_key())
+
+
+@dataclass(eq=False)
+class RoundRobinExchangeExec(P.PhysicalPlan):
+    """Balanced redistribution (RoundRobinPartitioning analogue)."""
+
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        d = X.axis_size()
+        rank = jnp.cumsum(pipe.mask.astype(jnp.int32)) - 1
+        target = ((rank + X.axis_index()) % d).astype(jnp.int32)
+        return X.exchange(pipe, target)
+
+    def plan_key(self):
+        return ("RoundRobinExchange", self.child.plan_key())
+
+
+@dataclass(eq=False)
+class RangeExchangeExec(P.PhysicalPlan):
+    """Range-partition rows by the leading sort key so device order ==
+    global sort order; a local sort downstream completes a distributed
+    global sort (reference: ShuffleExchangeExec.scala:280 + SortExec)."""
+
+    orders: Tuple[E.SortOrder, ...]
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        d = X.axis_size()
+        o = self.orders[0]
+        key = C.evaluate(o.child, pipe.env())
+        target = X.range_target(key, o.ascending, o.nulls_first_resolved, d,
+                                pipe.mask)
+        return X.exchange(pipe, target)
+
+    def node_string(self):
+        return f"Exchange[range({', '.join(map(str, self.orders))})]"
+
+    def plan_key(self):
+        return ("RangeExchange",
+                tuple((E.expr_key(o.child), o.ascending,
+                       o.nulls_first_resolved) for o in self.orders),
+                self.child.plan_key())
+
+
+@dataclass(eq=False)
+class BroadcastExchangeExec(P.PhysicalPlan):
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        return X.broadcast_gather(child_pipes[0])
+
+    def plan_key(self):
+        return ("BroadcastExchange", self.child.plan_key())
+
+
+@dataclass(eq=False)
+class SinglePartitionExchangeExec(P.PhysicalPlan):
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        return X.to_single_partition(child_pipes[0])
+
+    def plan_key(self):
+        return ("SingleExchange", self.child.plan_key())
+
+
+@dataclass(eq=False)
+class DistSampleExec(P.PhysicalPlan):
+    """Bernoulli sample with the device index folded into the PRNG key —
+    each shard draws independently (Spark seeds per partition the same
+    way: RDD.sample's per-split XORShift seed)."""
+
+    fraction: float
+    seed: int
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                 X.axis_index())
+        u = jax.random.uniform(key, (pipe.capacity,))
+        return Pipe(pipe.cols, pipe.mask & (u < self.fraction), pipe.order)
+
+    def plan_key(self):
+        return ("DistSample", self.fraction, self.seed,
+                self.child.plan_key())
+
+
+@dataclass(eq=False)
+class DistLimitExec(P.PhysicalPlan):
+    """Global limit without gathering: each device computes its rows'
+    GLOBAL live-rank as local-rank + exclusive prefix of earlier devices'
+    live counts (one tiny all_gather of scalars), then masks. The
+    reference runs limit as a separate single-partition stage
+    (limit.scala GlobalLimitExec after a shuffle); here it is one
+    collective of D int64s."""
+
+    n: int
+    offset: int
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        d = X.axis_size()
+        me = X.axis_index()
+        local = pipe.mask.astype(jnp.int64)
+        count = local.sum()[None]
+        all_counts = jax.lax.all_gather(count, X.DATA_AXIS, tiled=True)
+        prefix = jnp.where(jnp.arange(d) < me, all_counts, 0).sum()
+        rank = jnp.cumsum(local) - 1 + prefix
+        keep = pipe.mask & (rank >= self.offset) & (
+            rank < self.offset + self.n)
+        return Pipe(pipe.cols, keep, pipe.order)
+
+    def node_string(self):
+        return f"DistLimit[{self.n}]"
+
+    def plan_key(self):
+        return ("DistLimit", self.n, self.offset, self.child.plan_key())
+
+
+# ---- distributed aggregation ------------------------------------------------
+
+
+def _merged_agg(agg: E.AggregateExpression, env: Env, seg, mask,
+                num_segments: int, capacity: int) -> TV:
+    """One aggregate, locally reduced per segment then merged across the
+    mesh with psum/pmin/pmax — the partial->final two-phase plan
+    (reference: aggregate/AggUtils.scala:33 map-side combine + shuffled
+    merge) collapsed into a single program with an ICI collective as the
+    phase boundary."""
+    if isinstance(agg, E.Count) and agg.child is None:
+        return TV(X.psum(K.seg_count(seg, mask, num_segments)), None,
+                  T.INT64, None)
+
+    child = agg.child  # type: ignore[attr-defined]
+    tv = C.evaluate(child, env)
+    ok = mask & tv.valid_or_true(capacity)
+    cnt = X.psum(K.seg_count(seg, ok, num_segments))
+    any_valid = cnt > 0
+
+    if isinstance(agg, E.Count):
+        return TV(cnt, None, T.INT64, None)
+    if isinstance(agg, E.Sum):
+        out_dt = T.INT64 if tv.dtype.is_integral else tv.dtype
+        data = tv.data.astype(C._jnp_dtype(out_dt))
+        s = X.psum(K.seg_sum(data, seg, ok, num_segments))
+        return TV(s, any_valid, out_dt, None)
+    if isinstance(agg, E.Avg):
+        s = X.psum(K.seg_sum(tv.data.astype(jnp.float64), seg, ok,
+                             num_segments))
+        return TV(s / jnp.maximum(cnt, 1), any_valid, T.FLOAT64, None)
+    if isinstance(agg, E.Min):
+        return TV(X.pmin(K.seg_min(tv.data, seg, ok, num_segments)),
+                  any_valid, tv.dtype, tv.dictionary)
+    if isinstance(agg, E.Max):
+        return TV(X.pmax(K.seg_max(tv.data, seg, ok, num_segments)),
+                  any_valid, tv.dtype, tv.dictionary)
+    if isinstance(agg, E.StddevVariance):
+        x = tv.data.astype(jnp.float64)
+        c = cnt.astype(jnp.float64)
+        s = X.psum(K.seg_sum(x, seg, ok, num_segments))
+        s2 = X.psum(K.seg_sum(x * x, seg, ok, num_segments))
+        m2 = jnp.maximum(s2 - (s * s) / jnp.maximum(c, 1.0), 0.0)
+        kind = agg.kind
+        denom = c - 1.0 if kind.endswith("_samp") else c
+        var = m2 / jnp.maximum(denom, 1.0)
+        data = jnp.sqrt(var) if kind.startswith("stddev") else var
+        enough = c >= (2.0 if kind.endswith("_samp") else 1.0)
+        return TV(data, any_valid & enough, T.FLOAT64, None)
+    if isinstance(agg, E.First):
+        use = ok if agg.ignore_nulls else mask
+        data, found = K.seg_first(tv.data, seg, use, num_segments, capacity)
+        if tv.validity is not None:
+            vfirst, _ = K.seg_first(tv.valid_or_true(capacity), seg, use,
+                                    num_segments, capacity)
+        else:
+            vfirst = jnp.ones((num_segments,), jnp.bool_)
+        # choose the lowest device index that found a first row
+        d = X.axis_size()
+        me = X.axis_index()
+        winner = X.pmin(jnp.where(found, me, d))
+        mine = found & (me == winner)
+        zero = jnp.zeros((), dtype=data.dtype)
+        data = X.psum(jnp.where(mine, data, zero))
+        valid = X.psum(jnp.where(mine, vfirst, False).astype(jnp.int32)) > 0
+        return TV(data, (winner < d) & valid, tv.dtype, tv.dictionary)
+    raise NotImplementedError(f"distributed aggregate {agg!r}")
+
+
+@dataclass(eq=False)
+class PSumAggExec(P.PhysicalPlan):
+    """Direct-path aggregation over the mesh: dense group ids from
+    trace-time key cardinalities, segment-reduce locally, psum-merge
+    across devices — no shuffle at all. This is the north-star operator
+    (SURVEY.md §2 'Partial/final aggregation'). Output lives on device 0
+    (global arrays masked elsewhere)."""
+
+    groupings: Tuple[E.Expression, ...]
+    aggregates: Tuple[E.Expression, ...]
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return P.HashAggregateExec(self.groupings, self.aggregates,
+                                   self.child).schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        env = pipe.env()
+        cap = pipe.capacity
+        key_tvs = [C.evaluate(g, env) for g in self.groupings]
+        codes, validities, cards = P.group_key_codes(key_tvs)
+
+        if not key_tvs:
+            seg = jnp.zeros((cap,), dtype=jnp.int32)
+            num_segments = 1
+        else:
+            seg, num_segments = K.pack_codes(codes, validities, cards)
+            seg = seg.astype(jnp.int32)
+
+        _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
+        agg_tvs = [_merged_agg(a, env, seg, pipe.mask, num_segments, cap)
+                   for a in agg_calls]
+
+        present = X.psum(K.seg_count(seg, pipe.mask, num_segments)) > 0
+        if not key_tvs:
+            out_mask = jnp.ones((1,), dtype=jnp.bool_)
+            out_keys: List[TV] = []
+        else:
+            out_mask = present
+            nullable = [v is not None for v in validities]
+            unpacked = K.unpack_code(jnp.arange(num_segments), cards, nullable)
+            out_keys = []
+            for (code, valid), tv in zip(unpacked, key_tvs):
+                data = code.astype(C._jnp_dtype(tv.dtype))
+                out_keys.append(TV(data, valid, tv.dtype, tv.dictionary))
+        # result is replicated; keep one copy (device 0)
+        out_mask = jnp.where(X.axis_index() == 0, out_mask,
+                             jnp.zeros_like(out_mask))
+        agg_exec = P.HashAggregateExec(self.groupings, self.aggregates,
+                                       self.child)
+        return agg_exec._finalize(out_keys, agg_tvs, out_mask,
+                                  max(1, num_segments))
+
+    def node_string(self):
+        return (f"PSumAgg[keys=[{', '.join(map(str, self.groupings))}], "
+                f"out=[{', '.join(str(e) for e in self.aggregates)}]]")
+
+    def plan_key(self):
+        return ("PSumAgg", tuple(E.expr_key(g) for g in self.groupings),
+                tuple(E.expr_key(a) for a in self.aggregates),
+                self.child.plan_key())
+
+
+@dataclass(eq=False)
+class DistSortAggExec(P.PhysicalPlan):
+    """General group-by after a hash exchange: each device owns whole
+    groups, sorts locally, assigns group ids by change-flags. Fully
+    traceable — the static segment count is the row capacity (every row
+    its own group, worst case), so no host sync is needed inside the
+    program (contrast: single-device sort-agg host-syncs the group count;
+    reference contrast: TungstenAggregationIterator.scala:82 falls back
+    to sort-based with spills)."""
+
+    groupings: Tuple[E.Expression, ...]
+    aggregates: Tuple[E.Expression, ...]
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return P.HashAggregateExec(self.groupings, self.aggregates,
+                                   self.child).schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        cap = pipe.capacity
+        env = pipe.env()
+        key_tvs = [C.evaluate(g, env) for g in self.groupings]
+
+        spipe, sorted_keys, seg, ng = P.sorted_groups(pipe, key_tvs)
+        env2 = spipe.env()
+        _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
+        agg_tvs = [P._compute_agg(a, env2, seg, spipe.mask, cap, cap)
+                   for a in agg_calls]
+        out_keys = P.first_group_keys(sorted_keys, seg, spipe.mask, cap, cap)
+        out_mask = jnp.arange(cap) < ng
+        agg_exec = P.HashAggregateExec(self.groupings, self.aggregates,
+                                       self.child)
+        return agg_exec._finalize(out_keys, agg_tvs, out_mask, cap)
+
+    def node_string(self):
+        return (f"DistSortAgg[keys=[{', '.join(map(str, self.groupings))}], "
+                f"out=[{', '.join(str(e) for e in self.aggregates)}]]")
+
+    def plan_key(self):
+        return ("DistSortAgg", tuple(E.expr_key(g) for g in self.groupings),
+                tuple(E.expr_key(a) for a in self.aggregates),
+                self.child.plan_key())
+
+
+# ---- distributed join -------------------------------------------------------
+
+
+@dataclass(eq=False)
+class _SchemaLeaf(P.PhysicalPlan):
+    leaf_schema: Schema
+    traceable = True
+
+    @property
+    def schema(self) -> Schema:
+        return self.leaf_schema
+
+
+def join_output_schema(left: Schema, right: Schema, how: str) -> Schema:
+    return P.JoinExec(_SchemaLeaf(left), _SchemaLeaf(right), how, (), ()).schema
+
+
+def packed_join_keys(lpipe: Pipe, rpipe: Pipe,
+                     left_keys: Tuple[E.Expression, ...],
+                     right_keys: Tuple[E.Expression, ...],
+                     mins: Tuple[int, ...], ranges: Tuple[int, ...]):
+    """Pack equi-join keys into one int64 per row using STATIC per-key
+    min/range stats (host-supplied from a stats pass — the AQE runtime
+    statistics pattern, reference: adaptive/AdaptiveSparkPlanExec.scala:247).
+    Strings pack via trace-time unified dictionaries. Collision-free by
+    construction, unlike hashing."""
+    lenv, renv = lpipe.env(), rpipe.env()
+    lks = [C.evaluate(k, lenv) for k in left_keys]
+    rks = [C.evaluate(k, renv) for k in right_keys]
+    lcomb = jnp.zeros((lpipe.capacity,), dtype=jnp.int64)
+    rcomb = jnp.zeros((rpipe.capacity,), dtype=jnp.int64)
+    lvalid = jnp.ones((lpipe.capacity,), dtype=jnp.bool_)
+    rvalid = jnp.ones((rpipe.capacity,), dtype=jnp.bool_)
+    for (lt, rt), mn, rg in zip(zip(lks, rks), mins, ranges):
+        if isinstance(lt.dtype, T.StringType) or isinstance(rt.dtype, T.StringType):
+            _, (tl, tr) = C.unify_dictionaries(
+                (lt.dictionary or (), rt.dictionary or ()))
+            ld = jnp.asarray(tl)[lt.data] if len(lt.dictionary or ()) else lt.data
+            rd = jnp.asarray(tr)[rt.data] if len(rt.dictionary or ()) else rt.data
+        else:
+            ld = lt.data.astype(jnp.int64)
+            rd = rt.data.astype(jnp.int64)
+        lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
+        rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
+        if lt.validity is not None:
+            lvalid = lvalid & lt.validity
+        if rt.validity is not None:
+            rvalid = rvalid & rt.validity
+    return lcomb, lvalid, rcomb, rvalid
+
+
+@dataclass(eq=False)
+class JoinCountExec(P.PhysicalPlan):
+    """Stats pass: per-device equi-join match count (capacity sizing for
+    JoinApplyExec). Output: one int64 per device."""
+
+    left: P.PhysicalPlan
+    right: P.PhysicalPlan
+    left_keys: Tuple[E.Expression, ...]
+    right_keys: Tuple[E.Expression, ...]
+    mins: Tuple[int, ...]
+    ranges: Tuple[int, ...]
+    broadcast: bool
+    traceable = True
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def schema(self) -> Schema:
+        return Schema((Field("cnt", T.INT64, nullable=False),))
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        lpipe, rpipe = child_pipes
+        if self.broadcast:
+            rpipe = X.broadcast_gather(rpipe)
+        lkey, lvalid, rkey, rvalid = packed_join_keys(
+            lpipe, rpipe, self.left_keys, self.right_keys,
+            self.mins, self.ranges)
+        rng = K.build_join_ranges(rkey, rpipe.mask & rvalid,
+                                  lkey, lpipe.mask & lvalid)
+        cnt = jnp.where(lpipe.mask & lvalid, rng.counts, 0).sum(
+            dtype=jnp.int64)
+        return Pipe({"cnt": TV(cnt[None], None, T.INT64, None)},
+                    jnp.ones((1,), jnp.bool_), ["cnt"])
+
+    def plan_key(self):
+        return ("JoinCount", tuple(E.expr_key(k) for k in self.left_keys),
+                tuple(E.expr_key(k) for k in self.right_keys),
+                self.mins, self.ranges, self.broadcast,
+                self.left.plan_key(), self.right.plan_key())
+
+
+@dataclass(eq=False)
+class JoinApplyExec(P.PhysicalPlan):
+    """Per-device equi-join with a STATIC pair capacity (host-synced from
+    JoinCountExec). After a hash exchange both sides of a key group are
+    co-resident, so device-local sorted-build + searchsorted ranges +
+    vectorized pair expansion produce exactly the reference's shuffled
+    hash join semantics (ShuffledHashJoinExec.scala:38) — or, with
+    broadcast=True, the broadcast hash join (BroadcastHashJoinExec.scala:40)."""
+
+    left: P.PhysicalPlan
+    right: P.PhysicalPlan
+    how: str
+    left_keys: Tuple[E.Expression, ...]
+    right_keys: Tuple[E.Expression, ...]
+    condition: Optional[E.Expression]
+    mins: Tuple[int, ...]
+    ranges: Tuple[int, ...]
+    pair_capacity: int
+    broadcast: bool
+    traceable = True
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def schema(self) -> Schema:
+        return join_output_schema(self.left.schema, self.right.schema,
+                                  self.how)
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        lpipe, rpipe = child_pipes
+        how = self.how
+        if self.broadcast:
+            rpipe = X.broadcast_gather(rpipe)
+        if how == "cross":
+            return self._cross(lpipe, rpipe)
+
+        lkey, lvalid, rkey, rvalid = packed_join_keys(
+            lpipe, rpipe, self.left_keys, self.right_keys,
+            self.mins, self.ranges)
+        ranges = K.build_join_ranges(rkey, rpipe.mask & rvalid,
+                                     lkey, lpipe.mask & lvalid)
+
+        if how in ("left_semi", "left_anti") and self.condition is None:
+            has_match = ranges.counts > 0
+            keep = lpipe.mask & (has_match if how == "left_semi"
+                                 else ~has_match)
+            return Pipe(lpipe.cols, keep, lpipe.order)
+
+        cap = self.pair_capacity
+        p_idx, b_idx, pair_mask = K.expand_join_pairs(ranges, cap)
+
+        out_schema = self.schema
+        lnames = list(lpipe.order)
+        cols: Dict[str, TV] = {}
+        order: List[str] = []
+        for out_f, src_name in zip(out_schema.fields[:len(lnames)], lnames):
+            tv = lpipe.cols[src_name]
+            cols[out_f.name] = TV(
+                tv.data[p_idx],
+                None if tv.validity is None else tv.validity[p_idx],
+                tv.dtype, tv.dictionary)
+            order.append(out_f.name)
+        for out_f, src_name in zip(out_schema.fields[len(lnames):],
+                                   rpipe.order):
+            tv = rpipe.cols[src_name]
+            cols[out_f.name] = TV(
+                tv.data[b_idx],
+                None if tv.validity is None else tv.validity[b_idx],
+                tv.dtype, tv.dictionary)
+            order.append(out_f.name)
+
+        pair_ok = pair_mask
+        if self.condition is not None:
+            ctv = C.evaluate(self.condition, Env(cols, cap))
+            pair_ok = pair_ok & ctv.data & ctv.valid_or_true(cap)
+
+        if how == "inner":
+            return Pipe(cols, pair_ok, order)
+
+        matched = K.seg_count(p_idx, pair_ok, lpipe.capacity) > 0
+        if how == "left_semi":
+            return Pipe(lpipe.cols, lpipe.mask & matched, lpipe.order)
+        if how == "left_anti":
+            return Pipe(lpipe.cols, lpipe.mask & ~matched, lpipe.order)
+        matched_b = (K.seg_count(b_idx, pair_ok, rpipe.capacity) > 0
+                     if how in ("right", "full") else None)
+
+        helper = P.JoinExec(_SchemaLeaf(Schema(out_schema.fields[:len(lnames)])),
+                            _SchemaLeaf(Schema(out_schema.fields[len(lnames):])),
+                            how, self.left_keys, self.right_keys)
+        mask = pair_ok
+        if how in ("left", "full"):
+            cols, mask, order, _ = helper._append_unmatched_left(
+                cols, mask, order, lpipe, matched, out_schema)
+        if how in ("right", "full"):
+            if self.broadcast:
+                raise AssertionError(
+                    "right/full outer join must not broadcast the build side")
+            cols, mask, order, _ = helper._append_unmatched_right(
+                cols, mask, order, lpipe, rpipe, matched_b, out_schema)
+        return Pipe(cols, mask, order)
+
+    def _cross(self, lpipe: Pipe, rpipe: Pipe) -> Pipe:
+        """pair_capacity = per-device left capacity * global live right
+        rows (host-computed)."""
+        cap = self.pair_capacity
+        rn = max(1, cap // max(1, lpipe.capacity))
+        j = jnp.arange(cap)
+        p_idx = jnp.clip(j // rn, 0, lpipe.capacity - 1)
+        rperm = K.compaction_permutation(rpipe.mask)
+        b_idx = rperm[jnp.clip(j % rn, 0, rpipe.capacity - 1)]
+        live_r = jnp.cumsum(rpipe.mask.astype(jnp.int64))[-1]
+        pair_mask = lpipe.mask[p_idx] & ((j % rn) < live_r)
+
+        out_schema = self.schema
+        cols: Dict[str, TV] = {}
+        order: List[str] = []
+        for out_f, src_name in zip(out_schema.fields[:len(lpipe.order)],
+                                   lpipe.order):
+            tv = lpipe.cols[src_name]
+            cols[out_f.name] = TV(
+                tv.data[p_idx],
+                None if tv.validity is None else tv.validity[p_idx],
+                tv.dtype, tv.dictionary)
+            order.append(out_f.name)
+        for out_f, src_name in zip(out_schema.fields[len(lpipe.order):],
+                                   rpipe.order):
+            tv = rpipe.cols[src_name]
+            cols[out_f.name] = TV(
+                tv.data[b_idx],
+                None if tv.validity is None else tv.validity[b_idx],
+                tv.dtype, tv.dictionary)
+            order.append(out_f.name)
+        if self.condition is not None:
+            ctv = C.evaluate(self.condition, Env(cols, cap))
+            pair_mask = pair_mask & ctv.data & ctv.valid_or_true(cap)
+        return Pipe(cols, pair_mask, order)
+
+    def node_string(self):
+        ks = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys,
+                                                  self.right_keys))
+        tag = "broadcast" if self.broadcast else "partitioned"
+        return f"DistJoin[{self.how}, {tag}, ({ks}), cond={self.condition}]"
+
+    def plan_key(self):
+        return ("JoinApply", self.how,
+                tuple(E.expr_key(k) for k in self.left_keys),
+                tuple(E.expr_key(k) for k in self.right_keys),
+                None if self.condition is None else E.expr_key(self.condition),
+                self.mins, self.ranges, self.pair_capacity, self.broadcast,
+                self.left.plan_key(), self.right.plan_key())
+
+
+@dataclass(eq=False)
+class DistJoinBoundary(P.PhysicalPlan):
+    """Planner marker: a join that the executor lowers into (exchange) +
+    stats + count + apply stage programs. Not traceable — it is a stage
+    boundary, exactly where the reference's DAGScheduler cuts stages
+    (DAGScheduler.scala:1355 submitStage at ShuffleDependency edges)."""
+
+    left: P.PhysicalPlan
+    right: P.PhysicalPlan
+    how: str
+    left_keys: Tuple[E.Expression, ...]
+    right_keys: Tuple[E.Expression, ...]
+    condition: Optional[E.Expression]
+    traceable = False
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def schema(self) -> Schema:
+        if self.how in ("left_semi", "left_anti"):
+            return self.left.schema
+        return join_output_schema(self.left.schema, self.right.schema,
+                                  self.how)
+
+    def node_string(self):
+        return f"JoinBoundary[{self.how}]"
+
+    def plan_key(self):
+        return ("JoinBoundary", self.how,
+                tuple(E.expr_key(k) for k in self.left_keys),
+                tuple(E.expr_key(k) for k in self.right_keys),
+                None if self.condition is None else E.expr_key(self.condition),
+                self.left.plan_key(), self.right.plan_key())

@@ -265,6 +265,58 @@ def range_compress_keys(
     return combined, valid
 
 
+# ---- hashing / key encoding (partitioning support) --------------------------
+
+
+def hash64(x: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic 64-bit avalanche mix (splitmix64/xxh64 finalizer
+    shape). Role of the reference's Murmur3/XXH64 partitioning hashes
+    (common/unsafe hash/, catalyst XXH64.java) — used to route rows to
+    mesh devices; must be identical on every device."""
+    h = x.astype(jnp.uint64)
+    h = (h ^ (h >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+    h = (h ^ (h >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
+    h = h ^ (h >> 33)
+    return h
+
+
+def hash_combine(h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Fold another column into a running row hash."""
+    return hash64(h ^ (x.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)))
+
+
+def orderable_int64(
+    data: jnp.ndarray,
+    validity: Optional[jnp.ndarray],
+    ascending: bool = True,
+    nulls_first: bool = True,
+    rank_table: Optional[np.ndarray] = None,
+) -> jnp.ndarray:
+    """Encode a sort key column as int64 such that plain integer order ==
+    the SQL sort order (direction + null placement). Floats use the IEEE754
+    sign-flip bit trick; dictionary-coded strings go through a rank table.
+    This is the analogue of Spark's sort-key *prefix* encoding
+    (core/.../unsafe/sort/PrefixComparators.java) — but here the whole key
+    fits the prefix, because strings are dictionary ranks."""
+    if rank_table is not None:
+        y = jnp.asarray(rank_table, dtype=jnp.int64)[data]
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        bits = jax.lax.bitcast_convert_type(
+            data.astype(jnp.float64), jnp.uint64)
+        sign = (bits >> 63) == 1
+        u = jnp.where(sign, ~bits, bits | jnp.uint64(0x8000000000000000))
+        y = (u ^ jnp.uint64(0x8000000000000000)).astype(jnp.int64)
+    else:
+        y = data.astype(jnp.int64)
+    if not ascending:
+        y = ~y  # bitwise-not reverses integer order without overflow
+    if validity is not None:
+        imin = jnp.iinfo(jnp.int64).min
+        imax = jnp.iinfo(jnp.int64).max
+        y = jnp.where(validity, y, imin if nulls_first else imax)
+    return y
+
+
 # ---- misc ------------------------------------------------------------------
 
 

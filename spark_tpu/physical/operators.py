@@ -480,6 +480,60 @@ def rewrite_agg_outputs(
     return tuple(outputs), agg_calls
 
 
+def group_key_codes(key_tvs: List[TV]):
+    """Small-int codes + cardinalities for direct (packed) grouping.
+    Raises AssertionError when a key has no trace-time cardinality."""
+    codes, validities, cards = [], [], []
+    for tv in key_tvs:
+        if isinstance(tv.dtype, T.BooleanType):
+            codes.append(tv.data.astype(jnp.int32))
+            validities.append(tv.validity)
+            cards.append(2)
+        elif isinstance(tv.dtype, T.StringType) and tv.dictionary is not None:
+            codes.append(tv.data)
+            validities.append(tv.validity)
+            cards.append(max(1, len(tv.dictionary)))
+        else:
+            raise AssertionError(
+                "direct agg path needs trace-time key cardinality")
+    return codes, validities, cards
+
+
+def sorted_groups(pipe: Pipe, key_tvs: List[TV]):
+    """Sort rows by grouping keys and assign change-flag group ids.
+    Returns (sorted_pipe, sorted_key_tvs, seg_ids, num_groups_traced)."""
+    keys = [K.SortKey(tv.data, tv.validity, True, True) for tv in key_tvs]
+    perm = K.lexsort_permutation(keys, pipe.mask)
+
+    def take(tv: TV) -> TV:
+        return TV(tv.data[perm],
+                  None if tv.validity is None else tv.validity[perm],
+                  tv.dtype, tv.dictionary)
+
+    spipe = Pipe({name: take(tv) for name, tv in pipe.cols.items()},
+                 pipe.mask[perm], pipe.order)
+    sorted_keys = [take(tv) for tv in key_tvs]
+    seg, ng = K.group_ids_from_sorted(
+        [(tv.data, tv.validity) for tv in sorted_keys], spipe.mask)
+    return spipe, sorted_keys, seg, ng
+
+
+def first_group_keys(sorted_keys: List[TV], seg, mask, num_segments: int,
+                     capacity: int) -> List[TV]:
+    """Representative (first-row) key values per group."""
+    out = []
+    for tv in sorted_keys:
+        data, found = K.seg_first(tv.data, seg, mask, num_segments, capacity)
+        if tv.validity is None:
+            valid = None
+        else:
+            vdata, _ = K.seg_first(tv.validity, seg, mask, num_segments,
+                                   capacity)
+            valid = vdata & found
+        out.append(TV(data, valid, tv.dtype, tv.dictionary))
+    return out
+
+
 def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
                  num_segments: int, capacity: int) -> TV:
     """Compute one aggregate over segments. Nulls in the input are
@@ -622,20 +676,7 @@ class HashAggregateExec(PhysicalPlan):
         env = pipe.env()
         cap = pipe.capacity
         key_tvs = [C.evaluate(g, env) for g in self.groupings]
-
-        codes, validities, cards = [], [], []
-        for tv in key_tvs:
-            if isinstance(tv.dtype, T.BooleanType):
-                codes.append(tv.data.astype(jnp.int32))
-                validities.append(tv.validity)
-                cards.append(2)
-            elif isinstance(tv.dtype, T.StringType) and tv.dictionary is not None:
-                codes.append(tv.data)
-                validities.append(tv.validity)
-                cards.append(max(1, len(tv.dictionary)))
-            else:
-                raise AssertionError(
-                    "direct agg path needs trace-time key cardinality")
+        codes, validities, cards = group_key_codes(key_tvs)
 
         if not key_tvs:
             seg = jnp.zeros((cap,), dtype=jnp.int32)
@@ -675,27 +716,10 @@ class HashAggregateExec(PhysicalPlan):
 
         if not key_tvs:
             seg = jnp.zeros((cap,), dtype=jnp.int32)
-            pipe2, seg, n_groups = pipe, seg, 1
+            pipe2, n_groups = pipe, 1
             sorted_keys: List[TV] = []
         else:
-            keys = [K.SortKey(tv.data, tv.validity, True, True)
-                    for tv in key_tvs]
-            perm = K.lexsort_permutation(keys, pipe.mask)
-            cols = {
-                name: TV(tv.data[perm],
-                         None if tv.validity is None else tv.validity[perm],
-                         tv.dtype, tv.dictionary)
-                for name, tv in pipe.cols.items()
-            }
-            pipe2 = Pipe(cols, pipe.mask[perm], pipe.order)
-            sorted_keys = [
-                TV(tv.data[perm],
-                   None if tv.validity is None else tv.validity[perm],
-                   tv.dtype, tv.dictionary)
-                for tv in key_tvs
-            ]
-            seg, ng = K.group_ids_from_sorted(
-                [(tv.data, tv.validity) for tv in sorted_keys], pipe2.mask)
+            pipe2, sorted_keys, seg, ng = sorted_groups(pipe, key_tvs)
             n_groups = max(1, int(ng))  # host sync: output sizing
 
         num_segments = K.bucket(n_groups, 256)
@@ -703,17 +727,8 @@ class HashAggregateExec(PhysicalPlan):
         _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
         agg_tvs = [_compute_agg(a, env2, seg, pipe2.mask, num_segments, cap)
                    for a in agg_calls]
-        out_keys = []
-        for tv in sorted_keys:
-            data, found = K.seg_first(tv.data, seg, pipe2.mask,
-                                      num_segments, cap)
-            if tv.validity is None:
-                valid = None
-            else:
-                vdata, _ = K.seg_first(tv.validity, seg, pipe2.mask,
-                                       num_segments, cap)
-                valid = vdata & found
-            out_keys.append(TV(data, valid, tv.dtype, tv.dictionary))
+        out_keys = first_group_keys(sorted_keys, seg, pipe2.mask,
+                                    num_segments, cap)
         out_mask = jnp.arange(num_segments) < n_groups
         return self._finalize(out_keys, agg_tvs, out_mask,
                               num_segments).to_batch()

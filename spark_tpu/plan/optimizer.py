@@ -219,11 +219,41 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
                 child_req |= set(node.schema.names)
             return node.with_children((prune(node.children()[0], child_req),))
         if isinstance(node, L.Join):
+            # required/condition names live in the OUTPUT name space
+            # (right-side duplicates carry '#2' suffixes) — map them back
+            # to source columns before pruning each side.
             refs = set(required)
-            for e in node.expressions():
-                refs |= e.references()
-            left_req = {n for n in node.left.schema.names if n in refs}
-            right_req = {n for n in node.right.schema.names if n in refs}
+            if node.condition is not None:
+                refs |= node.condition.references()
+            seen: set = set()
+            left_req: set = set()
+            right_req: set = set()
+            entries = []  # (out_name, side_req_set, src_name) in dedup order
+            for side_req, names in ((left_req, node.left.schema.names),
+                                    (right_req, node.right.schema.names)):
+                for n in names:
+                    out = n
+                    while out in seen:
+                        out = out + "#2"
+                    seen.add(out)
+                    entries.append((out, side_req, n))
+            lookup = {out: (side_req, src) for out, side_req, src in entries}
+            needed = {out for out, _, _ in entries if out in refs}
+            # '#2' suffixes are collision-dependent: keeping 'x#2' only
+            # stays named 'x#2' if every dedup ancestor ('x') survives too
+            for out in list(needed):
+                base = out
+                while base.endswith("#2"):
+                    base = base[:-2]
+                    if base in lookup:
+                        needed.add(base)
+            for out in needed:
+                side_req, src = lookup[out]
+                side_req.add(src)
+            for k in node.left_keys:
+                left_req |= k.references()
+            for k in node.right_keys:
+                right_req |= k.references()
             return dataclasses.replace(
                 node,
                 left=prune(node.left, left_req),

@@ -65,3 +65,13 @@ def test_null_keys_never_match(spark):
     assert a.join(b, on="k").count() == 1  # SQL: NULL != NULL
     left = a.join(b, on="k", how="left").orderBy("v").collect()
     assert [(r.v, r.w) for r in left] == [(1, 3), (2, None)]
+
+
+def test_prune_join_dedup_column(spark):
+    """Optimizer column pruning must map '#2'-suffixed output names back
+    to right-side source columns (regression)."""
+    l = spark.createDataFrame([{"id": 1, "x": 10}, {"id": 2, "x": 20}])
+    r = spark.createDataFrame([{"id": 1, "x": 100}, {"id": 2, "x": 200}])
+    rows = (l.join(r, on="id", how="inner").select("x#2")
+            .sort("x#2").collect())
+    assert [row["x#2"] for row in rows] == [100, 200]
