@@ -93,25 +93,35 @@ def group_ids_from_sorted(
 
 def seg_sum(data, seg, mask, num_segments: int):
     zero = jnp.zeros((), dtype=data.dtype)
-    return jax.ops.segment_sum(jnp.where(mask, data, zero), seg,
-                               num_segments=num_segments)
+    masked = jnp.where(mask, data, zero)
+    if num_segments == 1:
+        # global aggregate: a plain reduction beats a 1-segment scatter-add
+        # (this is the AggregateBenchmark 'agg w/o group' hot path)
+        return jnp.sum(masked)[None]
+    return jax.ops.segment_sum(masked, seg, num_segments=num_segments)
 
 
 def seg_count(seg, mask, num_segments: int):
+    if num_segments == 1:
+        return jnp.sum(mask.astype(jnp.int64))[None]
     return jax.ops.segment_sum(mask.astype(jnp.int64), seg,
                                num_segments=num_segments)
 
 
 def seg_min(data, seg, mask, num_segments: int):
     big = _pos_sentinel(data.dtype)
-    return jax.ops.segment_min(jnp.where(mask, data, big), seg,
-                               num_segments=num_segments)
+    masked = jnp.where(mask, data, big)
+    if num_segments == 1:
+        return jnp.min(masked)[None]
+    return jax.ops.segment_min(masked, seg, num_segments=num_segments)
 
 
 def seg_max(data, seg, mask, num_segments: int):
     small = _neg_sentinel(data.dtype)
-    return jax.ops.segment_max(jnp.where(mask, data, small), seg,
-                               num_segments=num_segments)
+    masked = jnp.where(mask, data, small)
+    if num_segments == 1:
+        return jnp.max(masked)[None]
+    return jax.ops.segment_max(masked, seg, num_segments=num_segments)
 
 
 def seg_first(data, seg, mask, num_segments: int, capacity: int):
