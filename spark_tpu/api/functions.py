@@ -66,6 +66,20 @@ def countDistinct(c: ColumnOrName) -> Column:
 count_distinct = countDistinct
 
 
+def sumDistinct(c: ColumnOrName) -> Column:
+    return E.Sum(_c(c), distinct=True)
+
+
+sum_distinct = sumDistinct
+
+
+def avgDistinct(c: ColumnOrName) -> Column:
+    return E.Avg(_c(c), distinct=True)
+
+
+avg_distinct = avgDistinct
+
+
 def min(c: ColumnOrName) -> Column:  # noqa: A001
     return E.Min(_c(c))
 

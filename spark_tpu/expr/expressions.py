@@ -572,6 +572,7 @@ class AggregateExpression(Expression):
 @dataclass(eq=False, frozen=True)
 class Sum(AggregateExpression):
     child: Expression
+    distinct: bool = False
 
     def children(self):
         return (self.child,)
@@ -584,7 +585,8 @@ class Sum(AggregateExpression):
 
     @property
     def name(self):
-        return f"sum({self.child})"
+        d = "DISTINCT " if self.distinct else ""
+        return f"sum({d}{self.child})"
 
     def __str__(self):
         return self.name
@@ -593,6 +595,7 @@ class Sum(AggregateExpression):
 @dataclass(eq=False, frozen=True)
 class Avg(AggregateExpression):
     child: Expression
+    distinct: bool = False
 
     def children(self):
         return (self.child,)
@@ -602,7 +605,8 @@ class Avg(AggregateExpression):
 
     @property
     def name(self):
-        return f"avg({self.child})"
+        d = "DISTINCT " if self.distinct else ""
+        return f"avg({d}{self.child})"
 
     def __str__(self):
         return self.name

@@ -194,6 +194,33 @@ class MeshExecutor:
 
     def _plan_aggregate(self, groupings, aggregates,
                         child: P.PhysicalPlan) -> P.PhysicalPlan:
+        from spark_tpu.physical.operators import rewrite_agg_outputs
+
+        _, agg_calls = rewrite_agg_outputs(groupings, aggregates)
+        distinct_aggs = [a for a in agg_calls
+                         if getattr(a, "distinct", False)]
+        if distinct_aggs:
+            # DISTINCT needs equal values co-resident before local dedup
+            # (reference: RewriteDistinctAggregates.scala:1 plans an extra
+            # shuffle level; here it is one hash exchange).
+            if groupings:
+                # exchange on the grouping keys -> whole groups (and so
+                # all their values) live on one device; local dedup in
+                # _compute_agg is exact for any number of DISTINCT aggs.
+                ex = D.HashPartitionExchangeExec(tuple(groupings), child)
+                return D.DistSortAggExec(groupings, aggregates, ex)
+            # global aggregate: exchange on the distinct child so each
+            # value lives on exactly one device, then psum the deduped
+            # partials. All DISTINCT aggs must share one child set.
+            key_sets = {tuple(E.expr_key(c) for c in a.children())
+                        for a in distinct_aggs}
+            if len(key_sets) > 1:
+                raise NotImplementedError(
+                    "multiple DISTINCT aggregates over different columns "
+                    "in a global aggregate are not supported yet")
+            ex = D.HashPartitionExchangeExec(
+                tuple(distinct_aggs[0].children()), child)
+            return D.PSumAggExec(groupings, aggregates, ex)
         probe = P.HashAggregateExec(groupings, aggregates, child)
         if probe._static_direct_ok() or not groupings:
             # no shuffle: local partial + psum merge

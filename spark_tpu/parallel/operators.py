@@ -28,7 +28,8 @@ from spark_tpu.parallel import exchange as X
 from spark_tpu.parallel.sharded import ShardedBatch
 from spark_tpu.physical import kernels as K
 from spark_tpu.physical import operators as P
-from spark_tpu.physical.operators import Pipe, rewrite_agg_outputs
+from spark_tpu.physical.operators import (Pipe, _distinct_mask_cached,
+                                          rewrite_agg_outputs)
 from spark_tpu.types import Field, Schema
 
 
@@ -323,7 +324,15 @@ def _merged_agg(agg: E.AggregateExpression, env: Env, seg, mask,
     child = agg.child  # type: ignore[attr-defined]
     tv = C.evaluate(child, env)
     ok = mask & tv.valid_or_true(capacity)
+    if getattr(agg, "distinct", False):
+        # Local dedup + psum is exact ONLY when equal values are
+        # co-resident; the planner guarantees it by hash-exchanging on
+        # the distinct child (MeshExecutor._plan_aggregate) before this
+        # operator runs.
+        ok = ok & _distinct_mask_cached(env, agg.child, tv, seg, ok)
     cnt = X.psum(K.seg_count(seg, ok, num_segments))
+    # dedup keeps >= 1 head per non-empty group, so post-dedup positivity
+    # matches pre-dedup — no separate psum needed
     any_valid = cnt > 0
 
     if isinstance(agg, E.Count):

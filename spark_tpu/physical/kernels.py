@@ -196,6 +196,39 @@ def unpack_code(combined: jnp.ndarray, cardinalities: Sequence[int],
     return list(reversed(out))
 
 
+def distinct_first_mask(data: jnp.ndarray, seg: jnp.ndarray,
+                        ok: jnp.ndarray) -> jnp.ndarray:
+    """True for the first ok row of each (segment, value) pair.
+
+    DISTINCT-aggregate core (reference rewrite:
+    sql/catalyst/.../optimizer/RewriteDistinctAggregates.scala:1 plans a
+    two-level Expand+aggregate; here dedup is a device-local sort +
+    change-flag scatter, static-shape and jittable): sort rows by
+    (segment, value) with dead rows pushed to the back, mark value-group
+    heads, scatter the flags back to original row positions. ANDing the
+    result into an aggregate's ok-mask makes sum/count/avg see each value
+    once per group. Floats compare by canonicalized bit pattern so that
+    NaN == NaN for DISTINCT (Spark's NaN normalization,
+    NormalizeFloatingNumbers.scala) — float equality would count every
+    NaN as a fresh value."""
+    n = data.shape[0]
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        canon = jnp.where(jnp.isnan(data), jnp.nan, data)
+        canon = jnp.where(canon == 0.0, 0.0, canon)  # -0.0 -> +0.0
+        width = jnp.uint32 if data.dtype == jnp.float32 else jnp.uint64
+        data = jax.lax.bitcast_convert_type(canon, width)
+    keys = [SortKey(seg, None, True, True), SortKey(data, None, True, True)]
+    perm = lexsort_permutation(keys, ok)
+    sseg = seg[perm]
+    sval = data[perm]
+    sok = ok[perm]
+    head = jnp.concatenate([
+        jnp.ones((1,), jnp.bool_),
+        (sseg[1:] != sseg[:-1]) | (sval[1:] != sval[:-1])])
+    head = head & sok
+    return jnp.zeros((n,), jnp.bool_).at[perm].set(head)
+
+
 # ---- join ------------------------------------------------------------------
 
 

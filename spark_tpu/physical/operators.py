@@ -534,6 +534,20 @@ def first_group_keys(sorted_keys: List[TV], seg, mask, num_segments: int,
     return out
 
 
+def _distinct_mask_cached(env: Env, child: E.Expression, tv: TV, seg,
+                          ok) -> "jnp.ndarray":
+    """distinct_first_mask memoized per (env, child expr): N DISTINCT
+    aggregates over one column share a single (seg, value) lexsort."""
+    cache = getattr(env, "_distinct_cache", None)
+    if cache is None:
+        cache = {}
+        env._distinct_cache = cache
+    key = E.expr_key(child)
+    if key not in cache:
+        cache[key] = K.distinct_first_mask(tv.data, seg, ok)
+    return cache[key]
+
+
 def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
                  num_segments: int, capacity: int) -> TV:
     """Compute one aggregate over segments. Nulls in the input are
@@ -547,6 +561,10 @@ def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
     tv = C.evaluate(child, env)
     ok = mask & tv.valid_or_true(capacity)
     any_valid = K.seg_count(seg, ok, num_segments) > 0
+    if getattr(agg, "distinct", False):
+        # DISTINCT: keep one ok row per (group, value); any_valid is
+        # computed before dedup (unchanged by it anyway).
+        ok = ok & _distinct_mask_cached(env, agg.child, tv, seg, ok)
 
     if isinstance(agg, E.Count):
         cnt = K.seg_count(seg, ok, num_segments)
