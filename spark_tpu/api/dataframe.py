@@ -326,6 +326,12 @@ class DataFrame:
             print("|" + "|".join(v.ljust(w) for v, w in zip(row, widths)) + "|")
         print(sep)
 
+    @property
+    def write(self):
+        from spark_tpu.io.readwriter import DataFrameWriter
+
+        return DataFrameWriter(self)
+
     def createOrReplaceTempView(self, name: str) -> None:
         self._session.catalog._register_view(name, self._plan)
 

@@ -1,0 +1,8 @@
+"""File datasources: readers, writers, scan planning.
+
+The analogue of the reference's datasource layer (reference:
+sql/core/.../execution/datasources/ — DataSource.scala, FileFormat.scala,
+FileSourceStrategy.scala, DataSourceScanExec.scala:506) collapsed onto
+pyarrow.dataset: host-side async columnar decode feeds Arrow batches to
+the device via columnar/arrow.py.
+"""

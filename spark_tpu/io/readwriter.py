@@ -1,0 +1,190 @@
+"""DataFrameReader / DataFrameWriter — the spark.read / df.write surface
+(reference: sql/core/.../DataFrameReader.scala, DataFrameWriter.scala,
+FileFormatWriter.scala:1; python python/pyspark/sql/readwriter.py).
+
+Reads go through io.datasource.FileSource (pyarrow.dataset). Writes
+materialize the query to Arrow and emit Spark-shaped output: a DIRECTORY
+of part files (so outputs are re-readable by this reader and by Spark),
+with Spark's save modes and hive-style partitionBy.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from spark_tpu.plan import logical as L
+from spark_tpu.types import Schema
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self._session = session
+        self._format = "parquet"
+        self._schema: Optional[Schema] = None
+        self._options: Dict[str, Any] = {}
+
+    def format(self, fmt: str) -> "DataFrameReader":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def schema(self, schema: Union[Schema, str]) -> "DataFrameReader":
+        if isinstance(schema, str):
+            from spark_tpu.sql.ddl import parse_ddl_schema
+
+            schema = parse_ddl_schema(schema)
+        self._schema = schema
+        return self
+
+    def option(self, key: str, value: Any) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def options(self, **opts: Any) -> "DataFrameReader":
+        self._options.update(opts)
+        return self
+
+    def load(self, path: Union[str, Sequence[str]],
+             format: Optional[str] = None,  # noqa: A002
+             schema: Optional[Union[Schema, str]] = None,
+             **options: Any):
+        from spark_tpu.api.dataframe import DataFrame
+        from spark_tpu.io.datasource import FileSource
+
+        if format is not None:
+            self._format = format
+        if schema is not None:
+            self.schema(schema)
+        self._options.update(options)
+        paths = [path] if isinstance(path, str) else list(path)
+        source = FileSource(self._format, paths, self._schema, self._options)
+        return DataFrame(self._session, L.UnresolvedScan(source))
+
+    def parquet(self, *paths: str):
+        self._format = "parquet"
+        return self.load(list(paths) if len(paths) > 1 else paths[0])
+
+    def csv(self, path: Union[str, Sequence[str]],
+            schema: Optional[Union[Schema, str]] = None,
+            **options: Any):
+        self._format = "csv"
+        return self.load(path, schema=schema, **options)
+
+    def json(self, path: Union[str, Sequence[str]], **options: Any):
+        self._format = "json"
+        return self.load(path, **options)
+
+    def table(self, name: str):
+        return self._session.table(name)
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self._df = df
+        self._format = "parquet"
+        self._mode = "error"
+        self._options: Dict[str, Any] = {}
+        self._partition_by: List[str] = []
+
+    def format(self, fmt: str) -> "DataFrameWriter":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def mode(self, mode: str) -> "DataFrameWriter":
+        aliases = {"errorifexists": "error", "default": "error"}
+        mode = aliases.get(mode.lower(), mode.lower())
+        if mode not in ("error", "overwrite", "append", "ignore"):
+            raise ValueError(f"unknown save mode {mode!r}")
+        self._mode = mode
+        return self
+
+    def option(self, key: str, value: Any) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    def options(self, **opts: Any) -> "DataFrameWriter":
+        self._options.update(opts)
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = [c for group in cols
+                              for c in (group if isinstance(group, (list, tuple))
+                                        else [group])]
+        return self
+
+    # -- terminal actions ----------------------------------------------------
+
+    def save(self, path: str, format: Optional[str] = None,  # noqa: A002
+             mode: Optional[str] = None, **options: Any) -> None:
+        if format is not None:
+            self._format = format
+        if mode is not None:
+            self.mode(mode)
+        self._options.update(options)
+
+        exists = os.path.exists(path)
+        if exists:
+            if self._mode == "error":
+                raise FileExistsError(
+                    f"path {path} already exists (mode=error; use "
+                    "mode('overwrite') or mode('append'))")
+            if self._mode == "ignore":
+                return
+            if self._mode == "overwrite":
+                shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+
+        table = self._df.toArrow()
+        self._write_table(table, path)
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        import pyarrow.dataset as pads
+
+        part_id = uuid.uuid4().hex[:8]
+        basename = "part-{{i}}-{0}.{1}".format(part_id, self._format)
+        fmt: Any = self._format
+        write_opts = None
+        if self._format == "csv":
+            import pyarrow.csv as pacsv
+
+            header = str(self._options.get("header", "true")).lower() == "true"
+            delim = self._options.get(
+                "sep", self._options.get("delimiter", ","))
+            fmt = pads.CsvFileFormat(
+                parse_options=pacsv.ParseOptions(delimiter=delim))
+            write_opts = fmt.make_write_options(
+                include_header=header, delimiter=delim)
+        elif self._format == "json":
+            # pyarrow.dataset cannot write json; emit one ndjson part
+            os.makedirs(path, exist_ok=True)
+            fname = os.path.join(path, f"part-00000-{part_id}.json")
+            table.to_pandas().to_json(fname, orient="records", lines=True,
+                                      date_format="iso")
+            return
+        pads.write_dataset(
+            table, path, format=fmt,
+            file_options=write_opts,
+            basename_template=basename,
+            partitioning=(pads.partitioning(
+                pa.schema([table.schema.field(c)
+                           for c in self._partition_by]), flavor="hive")
+                          if self._partition_by else None),
+            existing_data_behavior="overwrite_or_ignore")
+
+    def parquet(self, path: str, mode: Optional[str] = None) -> None:
+        self.save(path, format="parquet", mode=mode)
+
+    def csv(self, path: str, mode: Optional[str] = None,
+            **options: Any) -> None:
+        self.save(path, format="csv", mode=mode, **options)
+
+    def json(self, path: str, mode: Optional[str] = None) -> None:
+        self.save(path, format="json", mode=mode)
+
+    def saveAsTable(self, name: str) -> None:
+        """Register the materialized result in the session catalog."""
+        df = self._df
+        df._session.catalog._register_view(name, L.Relation(df._execute()))

@@ -150,7 +150,8 @@ class MeshExecutor:
         if isinstance(plan, L.Relation):
             return D.ShardScanExec(self._shard_relation(plan.batch))
         if isinstance(plan, L.UnresolvedScan):
-            return D.ShardScanExec(self._shard_relation(plan.source.read()))
+            return D.ShardScanExec(self._shard_relation(
+                plan.source.read(plan.columns, plan.filters)))
         if isinstance(plan, L.Range):
             n = plan.num_rows
             p = K.bucket(math.ceil(max(1, n) / d), 128)
@@ -199,19 +200,12 @@ class MeshExecutor:
         _, agg_calls = rewrite_agg_outputs(groupings, aggregates)
         distinct_aggs = [a for a in agg_calls
                          if getattr(a, "distinct", False)]
-        if distinct_aggs:
-            # DISTINCT needs equal values co-resident before local dedup
-            # (reference: RewriteDistinctAggregates.scala:1 plans an extra
-            # shuffle level; here it is one hash exchange).
-            if groupings:
-                # exchange on the grouping keys -> whole groups (and so
-                # all their values) live on one device; local dedup in
-                # _compute_agg is exact for any number of DISTINCT aggs.
-                ex = D.HashPartitionExchangeExec(tuple(groupings), child)
-                return D.DistSortAggExec(groupings, aggregates, ex)
-            # global aggregate: exchange on the distinct child so each
+        if distinct_aggs and not groupings:
+            # Global DISTINCT: exchange on the distinct child so each
             # value lives on exactly one device, then psum the deduped
-            # partials. All DISTINCT aggs must share one child set.
+            # partials (reference: RewriteDistinctAggregates.scala:1
+            # plans an extra shuffle level; here it is one hash
+            # exchange). All DISTINCT aggs must share one child set.
             key_sets = {tuple(E.expr_key(c) for c in a.children())
                         for a in distinct_aggs}
             if len(key_sets) > 1:
@@ -222,9 +216,11 @@ class MeshExecutor:
                 tuple(distinct_aggs[0].children()), child)
             return D.PSumAggExec(groupings, aggregates, ex)
         probe = P.HashAggregateExec(groupings, aggregates, child)
-        if probe._static_direct_ok() or not groupings:
+        if not distinct_aggs and (probe._static_direct_ok() or not groupings):
             # no shuffle: local partial + psum merge
             return D.PSumAggExec(groupings, aggregates, child)
+        # exchange on the grouping keys -> whole groups (and for DISTINCT
+        # all their values) live on one device; local sort-agg is exact.
         ex = D.HashPartitionExchangeExec(tuple(groupings), child)
         return D.DistSortAggExec(groupings, aggregates, ex)
 

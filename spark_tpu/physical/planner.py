@@ -37,7 +37,7 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
     if isinstance(plan, L.Range):
         return P.RangeExec(plan.start, plan.end, plan.step, plan.col_name)
     if isinstance(plan, L.UnresolvedScan):
-        return P.BatchScanExec(plan.source.read())
+        return P.BatchScanExec(plan.source.read(plan.columns, plan.filters))
     if isinstance(plan, L.Project):
         return P.ProjectExec(plan.exprs, plan_physical(plan.child))
     if isinstance(plan, L.Filter):

@@ -150,18 +150,32 @@ class Range(LogicalPlan):
 
 @dataclass(eq=False, frozen=True)
 class UnresolvedScan(LogicalPlan):
-    """A named table / file source resolved by the session catalog at
-    physical planning time (DSv2 Scan analogue)."""
+    """A file/table scan with pushed-down projection and predicates
+    (DSv2 Scan + SupportsPushDownRequiredColumns/Filters analogue,
+    reference: sql/catalyst/.../connector/read/SupportsPushDown*.java;
+    physical peer FileSourceScanExec, DataSourceScanExec.scala:506).
+    ``columns=None`` means all; ``filters`` are exact (the source both
+    prunes files/row-groups and filters rows by them)."""
 
     source: Any  # io datasource object with .schema and .read()
     options: Tuple[Tuple[str, str], ...] = ()
+    columns: Optional[Tuple[str, ...]] = None
+    filters: Tuple[E.Expression, ...] = ()
 
     @property
     def schema(self) -> Schema:
-        return self.source.schema
+        full = self.source.schema
+        if self.columns is None:
+            return full
+        return Schema(tuple(full.field(n) for n in self.columns))
 
     def node_string(self):
-        return f"Scan({self.source})"
+        parts = [str(self.source)]
+        if self.columns is not None:
+            parts.append(f"cols={list(self.columns)}")
+        if self.filters:
+            parts.append(f"pushed=[{', '.join(map(str, self.filters))}]")
+        return f"Scan({', '.join(parts)})"
 
 
 # ---- unary -----------------------------------------------------------------

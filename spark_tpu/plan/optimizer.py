@@ -126,6 +126,20 @@ def push_down_predicates(plan: L.LogicalPlan) -> L.LogicalPlan:
         child = node.child
         if isinstance(child, L.Filter):
             return L.Filter(E.And(child.condition, node.condition), child.child)
+        if isinstance(child, L.UnresolvedScan):
+            # push translatable conjuncts into the scan (file/row-group
+            # pruning + exact row filtering at the source; reference:
+            # FileSourceStrategy / V2ScanRelationPushDown)
+            from spark_tpu.io.datasource import translate_filters
+
+            pushed, residual = translate_filters(
+                split_conjuncts(node.condition))
+            if pushed:
+                new_scan = dataclasses.replace(
+                    child, filters=child.filters + tuple(pushed))
+                if residual:
+                    return L.Filter(combine_conjuncts(residual), new_scan)
+                return new_scan
         if isinstance(child, L.Project):
             has_agg = any(E.contains_aggregate(e) for e in child.exprs)
             if not has_agg:
@@ -189,7 +203,16 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
     FileSourceStrategy's readDataColumns)."""
 
     def prune(node: L.LogicalPlan, required: set) -> L.LogicalPlan:
-        if isinstance(node, (L.Relation, L.Range, L.UnresolvedScan)):
+        if isinstance(node, L.UnresolvedScan):
+            # column-projection pushdown: the scan reads only what the
+            # query needs (pushed filters are evaluated by the source
+            # independent of the projection)
+            names = node.schema.names
+            keep = tuple(n for n in names if n in required)
+            if 0 < len(keep) < len(names):
+                return dataclasses.replace(node, columns=keep)
+            return node
+        if isinstance(node, (L.Relation, L.Range)):
             names = node.schema.names
             keep = [n for n in names if n in required]
             if 0 < len(keep) < len(names):

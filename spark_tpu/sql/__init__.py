@@ -1,0 +1,8 @@
+"""SQL front-end: tokenizer, parser, DDL schema strings.
+
+The analogue of the reference's ANTLR grammar + AstBuilder (reference:
+sql/catalyst/src/main/antlr4/.../SqlBaseParser.g4:1 — 1,819 lines —
+and parser/AstBuilder.scala), hand-written as a Pratt/recursive-descent
+parser sized to the dialect the engine executes (TPC-H and the DataFrame
+feature set).
+"""
