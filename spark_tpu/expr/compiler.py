@@ -234,6 +234,34 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             (n,), dtype=jnp.bool_)
         return TV(res, tv.validity, T.BOOLEAN, None)
 
+    if isinstance(expr, E.Concat):
+        tvs = [evaluate(a, env) for a in expr.args]
+        for tv in tvs:
+            if not isinstance(tv.dtype, T.StringType):
+                raise NotImplementedError("CONCAT supports strings only")
+        total = 1
+        for tv in tvs:
+            total *= max(1, len(tv.dictionary or ()))
+        if total > (1 << 20):
+            raise NotImplementedError(
+                f"CONCAT dictionary product too large ({total})")
+        # cartesian dictionary, mixed-radix codes; then re-sort/dedup
+        dicts = [tv.dictionary or ("",) for tv in tvs]
+        combo: list = [""]
+        for d in dicts:
+            combo = [a + b for a in combo for b in d]
+        new_dict = tuple(sorted(set(combo)))
+        pos = {s: i for i, s in enumerate(new_dict)}
+        remap = np.array([pos[s] for s in combo], dtype=np.int32)
+        codes = jnp.zeros((n,), dtype=jnp.int32)
+        validity = None
+        for tv, d in zip(tvs, dicts):
+            c = tv.data if len(tv.dictionary or ()) else jnp.zeros(
+                (n,), jnp.int32)
+            codes = codes * len(d) + c
+            validity = _and_validity(validity, tv.validity)
+        return TV(jnp.asarray(remap)[codes], validity, T.STRING, new_dict)
+
     if isinstance(expr, E.Substring):
         tv = evaluate(expr.child, env)
         dictionary = tv.dictionary or ()

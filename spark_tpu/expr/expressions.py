@@ -516,6 +516,24 @@ class Substring(Expression):
 
 
 @dataclass(eq=False, frozen=True)
+class Concat(Expression):
+    """String concatenation (|| / concat()). Evaluated over host
+    dictionaries: the output dictionary is the cartesian product of the
+    input dictionaries (guarded), codes combine by mixed radix."""
+
+    args: Tuple[Expression, ...]
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def __str__(self):
+        return f"CONCAT({', '.join(map(str, self.args))})"
+
+
+@dataclass(eq=False, frozen=True)
 class Abs(Expression):
     child: Expression
 
@@ -527,6 +545,83 @@ class Abs(Expression):
 
     def __str__(self):
         return f"ABS({self.child})"
+
+
+# ---- subquery expressions ---------------------------------------------------
+
+
+@dataclass(eq=False, frozen=True)
+class OuterRef(Expression):
+    """A correlated reference to a column of the OUTER query inside a
+    subquery (reference: expressions/subquery.scala OuterReference).
+    Resolved dtype is captured at parse time; decorrelation
+    (plan/subquery.py) eliminates these before execution."""
+
+    col_name: str
+    dtype: DataType = None  # type: ignore[assignment]
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.dtype
+
+    def references(self) -> set:
+        return set()  # not a reference of the INNER plan
+
+    def __str__(self):
+        return f"outer({self.col_name})"
+
+
+class SubqueryExpression(Expression):
+    """Marker base (reference: expressions/subquery.scala)."""
+
+
+@dataclass(eq=False, frozen=True)
+class ScalarSubquery(SubqueryExpression):
+    plan: Any  # LogicalPlan producing one row, one column
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.plan.schema.fields[0].dtype
+
+    def __str__(self):
+        return "scalar-subquery(...)"
+
+
+@dataclass(eq=False, frozen=True)
+class InSubquery(SubqueryExpression):
+    child: Expression
+    plan: Any  # LogicalPlan producing one column
+    negated: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema: Schema) -> DataType:
+        return T.BOOLEAN
+
+    def __str__(self):
+        n = "NOT " if self.negated else ""
+        return f"({self.child} {n}IN subquery(...))"
+
+
+@dataclass(eq=False, frozen=True)
+class Exists(SubqueryExpression):
+    plan: Any  # LogicalPlan
+    negated: bool = False
+
+    def data_type(self, schema: Schema) -> DataType:
+        return T.BOOLEAN
+
+    def nullable(self, schema):
+        return False
+
+    def __str__(self):
+        n = "NOT " if self.negated else ""
+        return f"{n}EXISTS(...)"
+
+
+def contains_subquery(e: Expression) -> bool:
+    if isinstance(e, SubqueryExpression):
+        return True
+    return any(contains_subquery(c) for c in e.children())
 
 
 # ---- sort order ------------------------------------------------------------

@@ -648,25 +648,27 @@ class JoinApplyExec(P.PhysicalPlan):
         cap = self.pair_capacity
         p_idx, b_idx, pair_mask = K.expand_join_pairs(ranges, cap)
 
-        out_schema = self.schema
+        # pair env always carries BOTH sides so semi/anti conditions can
+        # reference the inner relation (names match Join.schema dedup)
+        pair_names = P._pair_names(lpipe.order, rpipe.order)
         lnames = list(lpipe.order)
         cols: Dict[str, TV] = {}
         order: List[str] = []
-        for out_f, src_name in zip(out_schema.fields[:len(lnames)], lnames):
+        for out_name, src_name in zip(pair_names[:len(lnames)], lnames):
             tv = lpipe.cols[src_name]
-            cols[out_f.name] = TV(
+            cols[out_name] = TV(
                 tv.data[p_idx],
                 None if tv.validity is None else tv.validity[p_idx],
                 tv.dtype, tv.dictionary)
-            order.append(out_f.name)
-        for out_f, src_name in zip(out_schema.fields[len(lnames):],
-                                   rpipe.order):
+            order.append(out_name)
+        for out_name, src_name in zip(pair_names[len(lnames):],
+                                      rpipe.order):
             tv = rpipe.cols[src_name]
-            cols[out_f.name] = TV(
+            cols[out_name] = TV(
                 tv.data[b_idx],
                 None if tv.validity is None else tv.validity[b_idx],
                 tv.dtype, tv.dictionary)
-            order.append(out_f.name)
+            order.append(out_name)
 
         pair_ok = pair_mask
         if self.condition is not None:

@@ -765,6 +765,20 @@ class HashAggregateExec(PhysicalPlan):
 # ---- join ------------------------------------------------------------------
 
 
+def _pair_names(left_names, right_names) -> List[str]:
+    """Joined-pair column names: left keeps its names, right duplicates
+    get '#2' suffixes (must match Join.schema dedup)."""
+    seen = set()
+    out = []
+    for n in list(left_names) + list(right_names):
+        name = n
+        while name in seen:
+            name = name + "#2"
+        seen.add(name)
+        out.append(name)
+    return out
+
+
 @dataclass(eq=False)
 class JoinExec(PhysicalPlan):
     """Equi-join via sorted-build + searchsorted ranges (reference:
@@ -883,26 +897,28 @@ class JoinExec(PhysicalPlan):
         cap = K.bucket(total)
         p_idx, b_idx, pair_mask = K.expand_join_pairs(ranges, cap)
 
-        out_schema = self.schema
+        # The pair environment always carries BOTH sides (with '#2'
+        # dedup names) so semi/anti join conditions can reference the
+        # inner relation; the output schema narrows afterwards.
+        pair_names = _pair_names(lpipe.order, rpipe.order)
         lnames = list(lpipe.order)
         cols: Dict[str, TV] = {}
         order: List[str] = []
-        for out_f, src_name in zip(out_schema.fields[:len(lnames)], lnames):
+        for out_name, src_name in zip(pair_names[:len(lnames)], lnames):
             tv = lpipe.cols[src_name]
-            cols[out_f.name] = TV(
+            cols[out_name] = TV(
                 tv.data[p_idx],
                 None if tv.validity is None else tv.validity[p_idx],
                 tv.dtype, tv.dictionary)
-            order.append(out_f.name)
-        if how not in ("left_semi", "left_anti"):
-            for out_f, src_name in zip(out_schema.fields[len(lnames):],
-                                       rpipe.order):
-                tv = rpipe.cols[src_name]
-                cols[out_f.name] = TV(
-                    tv.data[b_idx],
-                    None if tv.validity is None else tv.validity[b_idx],
-                    tv.dtype, tv.dictionary)
-                order.append(out_f.name)
+            order.append(out_name)
+        for out_name, src_name in zip(pair_names[len(lnames):],
+                                      rpipe.order):
+            tv = rpipe.cols[src_name]
+            cols[out_name] = TV(
+                tv.data[b_idx],
+                None if tv.validity is None else tv.validity[b_idx],
+                tv.dtype, tv.dictionary)
+            order.append(out_name)
 
         pair_ok = pair_mask
         if self.condition is not None:

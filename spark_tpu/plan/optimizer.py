@@ -177,6 +177,59 @@ def push_down_predicates(plan: L.LogicalPlan) -> L.LogicalPlan:
     return plan.transform_up(fn)
 
 
+def extract_equi_joins(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Filter(Join(cross/inner)) with cross-side equality conjuncts ->
+    equi join keys (reference: planning/patterns.scala ExtractEquiJoinKeys
+    + the planner turning ON-less comma joins into hash joins). Essential
+    for SQL comma-style joins: FROM a, b WHERE a.k = b.k."""
+
+    def fn(node: L.LogicalPlan) -> L.LogicalPlan:
+        if not (isinstance(node, L.Filter) and isinstance(node.child, L.Join)):
+            return node
+        join = node.child
+        if join.how not in ("cross", "inner"):
+            return node
+        out_names = join.schema.names
+        n_l = len(join.left.schema.names)
+        left_out = set(out_names[:n_l])
+        right_out_map = dict(zip(out_names[n_l:], join.right.schema.names))
+
+        def to_src(e: E.Expression) -> E.Expression:
+            def sub(x):
+                if isinstance(x, E.Col) and x.col_name in right_out_map:
+                    return E.Col(right_out_map[x.col_name])
+                return x
+
+            return E.transform_expr(e, sub)
+
+        lkeys = list(join.left_keys)
+        rkeys = list(join.right_keys)
+        keep: List[E.Expression] = []
+        changed = False
+        for c in split_conjuncts(node.condition):
+            if isinstance(c, E.Cmp) and c.op == "==":
+                lr, rr = c.left.references(), c.right.references()
+                if lr and lr <= left_out and rr and rr <= set(right_out_map):
+                    lkeys.append(c.left)
+                    rkeys.append(to_src(c.right))
+                    changed = True
+                    continue
+                if rr and rr <= left_out and lr and lr <= set(right_out_map):
+                    lkeys.append(c.right)
+                    rkeys.append(to_src(c.left))
+                    changed = True
+                    continue
+            keep.append(c)
+        if not changed:
+            return node
+        new_join = L.Join(join.left, join.right, "inner",
+                          tuple(lkeys), tuple(rkeys), join.condition)
+        return L.Filter(combine_conjuncts(keep), new_join) if keep \
+            else new_join
+
+    return plan.transform_up(fn)
+
+
 def prune_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
     def fn(node: L.LogicalPlan) -> L.LogicalPlan:
         if isinstance(node, L.Filter) and isinstance(node.condition, E.Literal):
@@ -299,6 +352,7 @@ Rule = Callable[[L.LogicalPlan], L.LogicalPlan]
 _FIXED_POINT_BATCH: Tuple[Rule, ...] = (
     constant_folding,
     push_down_predicates,
+    extract_equi_joins,
     collapse_projects,
     prune_filters,
 )

@@ -1,0 +1,286 @@
+"""Subquery rewriting: EXISTS/IN -> semi/anti joins, scalar subqueries
+-> aggregate joins, with decorrelation of equality predicates.
+
+The analogue of the reference's subquery planning + decorrelation tier
+(reference: sql/catalyst/.../optimizer/subquery.scala
+RewritePredicateSubquery, DecorrelateInnerQuery.scala,
+RewriteCorrelatedScalarSubquery in Optimizer.scala). Correlated
+references are OuterRef nodes captured at parse time; this pass removes
+every SubqueryExpression from the plan, so the executors never see one.
+
+Supported shapes (the TPC-H dialect):
+- [NOT] EXISTS (SELECT ... WHERE outer_eq AND ... [non-equi corr]) —
+  equality conjuncts become semi/anti join keys, other correlated
+  conjuncts become the join condition.
+- expr [NOT] IN (SELECT col ...), optionally correlated by equalities.
+- scalar subqueries: uncorrelated (cross join of a 1-row aggregate) and
+  correlated-by-equality aggregates (GROUP BY the correlation columns +
+  LEFT JOIN — empty groups yield NULL, matching SQL).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Tuple
+
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+
+_sq_counter = itertools.count()
+
+
+def _has_outer(e: E.Expression) -> bool:
+    if isinstance(e, E.OuterRef):
+        return True
+    return any(_has_outer(c) for c in e.children())
+
+
+def _outer_to_col(e: E.Expression) -> E.Expression:
+    def fn(x):
+        if isinstance(x, E.OuterRef):
+            return E.Col(x.col_name)
+        return x
+
+    return E.transform_expr(e, fn)
+
+
+def _pure_outer(e: E.Expression) -> bool:
+    """Only OuterRefs and literals below (no inner columns)."""
+    if isinstance(e, E.Col):
+        return False
+    if isinstance(e, (E.OuterRef, E.Literal)):
+        return True
+    return bool(e.children()) and all(_pure_outer(c) for c in e.children()) \
+        or isinstance(e, E.Literal)
+
+
+def _pure_inner(e: E.Expression) -> bool:
+    return not _has_outer(e)
+
+
+def _split(cond: E.Expression) -> List[E.Expression]:
+    from spark_tpu.plan.optimizer import split_conjuncts
+
+    return split_conjuncts(cond)
+
+
+def _combine(parts: List[E.Expression]) -> E.Expression:
+    from spark_tpu.plan.optimizer import combine_conjuncts
+
+    return combine_conjuncts(parts)
+
+
+def _strip_correlated(
+    plan: L.LogicalPlan,
+) -> Tuple[L.LogicalPlan, List[E.Expression], bool]:
+    """Remove correlated conjuncts from Filter nodes anywhere in the
+    plan. Returns (stripped_plan, conjuncts, found_below_agg)."""
+    collected: List[E.Expression] = []
+    below_agg = False
+
+    def go(node: L.LogicalPlan, under_agg: bool) -> L.LogicalPlan:
+        nonlocal below_agg
+        child_under = under_agg or isinstance(node, L.Aggregate)
+        children = tuple(go(c, child_under) for c in node.children())
+        node = node.with_children(children) if children else node
+        if isinstance(node, L.Filter):
+            parts = _split(node.condition)
+            corr = [p for p in parts if _has_outer(p)]
+            rest = [p for p in parts if not _has_outer(p)]
+            if corr:
+                collected.extend(corr)
+                if under_agg:
+                    below_agg = True
+                return L.Filter(_combine(rest), node.child) if rest \
+                    else node.child
+        return node
+
+    return go(plan, False), collected, below_agg
+
+
+def _corr_to_keys(
+    corr: List[E.Expression],
+) -> Tuple[List[E.Expression], List[E.Expression], List[E.Expression]]:
+    """Split correlated conjuncts into (outer_keys, inner_keys, residual).
+    Equalities with one pure-outer and one pure-inner side become key
+    pairs; everything else is residual (goes to the join condition)."""
+    outer_keys: List[E.Expression] = []
+    inner_keys: List[E.Expression] = []
+    residual: List[E.Expression] = []
+    for p in corr:
+        if isinstance(p, E.Cmp) and p.op == "==":
+            if _pure_outer(p.left) and _pure_inner(p.right):
+                outer_keys.append(_outer_to_col(p.left))
+                inner_keys.append(p.right)
+                continue
+            if _pure_outer(p.right) and _pure_inner(p.left):
+                outer_keys.append(_outer_to_col(p.right))
+                inner_keys.append(p.left)
+                continue
+        residual.append(p)
+    return outer_keys, inner_keys, residual
+
+
+def _join_condition(residual: List[E.Expression], left_names,
+                    right_names) -> Optional[E.Expression]:
+    """Residual correlated conjuncts reference outer columns as OuterRef
+    and inner columns by their own names; the join condition evaluates
+    on the joined pair where right-side duplicates carry '#2' suffixes
+    (logical.Join.schema dedup). Rewrite both."""
+    if not residual:
+        return None
+    seen = set(left_names)
+    rename = {}
+    for n in right_names:
+        out = n
+        while out in seen:
+            out = out + "#2"
+        seen.add(out)
+        rename[n] = out
+
+    def fix(e: E.Expression) -> E.Expression:
+        def fn(x):
+            if isinstance(x, E.OuterRef):
+                return E.Col(x.col_name)
+            if isinstance(x, E.Col) and x.col_name in rename:
+                return E.Col(rename[x.col_name])
+            return x
+
+        return E.transform_expr(e, fn)
+
+    return _combine([fix(p) for p in residual])
+
+
+def _apply_exists(plan: L.LogicalPlan, ex: E.Exists) -> L.LogicalPlan:
+    sub = rewrite_subqueries(ex.plan)
+    stripped, corr, below_agg = _strip_correlated(sub)
+    if below_agg:
+        raise NotImplementedError(
+            "correlated predicate below an aggregate inside EXISTS")
+    how = "left_anti" if ex.negated else "left_semi"
+    if not corr:
+        # uncorrelated EXISTS: keep all or no rows depending on whether
+        # the subquery has any row — a 1-row COUNT()>0 cross join + filter
+        flag = L.Aggregate(
+            (), (E.Alias(E.Cmp(">", E.Count(None), E.Literal(0)),
+                         "__exists__"),), stripped)
+        joined = L.Join(plan, flag, "cross", (), ())
+        cond = E.Col("__exists__") if not ex.negated \
+            else E.Not(E.Col("__exists__"))
+        return L.Project(tuple(E.Col(n) for n in plan.schema.names),
+                         L.Filter(cond, joined))
+    outer_keys, inner_keys, residual = _corr_to_keys(corr)
+    cond = _join_condition(residual, plan.schema.names,
+                           stripped.schema.names)
+    return L.Join(plan, stripped, how, tuple(outer_keys),
+                  tuple(inner_keys), cond)
+
+
+def _apply_in(plan: L.LogicalPlan, isq: E.InSubquery) -> L.LogicalPlan:
+    """[NOT] IN (subquery) as a semi/anti join on value equality (+ any
+    correlated equalities). NOTE: NOT IN with NULLs in the subquery
+    result follows the join (row-keeping) semantics, not SQL's
+    three-valued 'all NULL comparisons' rule — matching keys only."""
+    sub = rewrite_subqueries(isq.plan)
+    stripped, corr, below_agg = _strip_correlated(sub)
+    if below_agg:
+        raise NotImplementedError(
+            "correlated predicate below an aggregate inside IN subquery")
+    outer_keys, inner_keys, residual = _corr_to_keys(corr)
+    value_col = stripped.schema.names[0]
+    outer_keys = [isq.child] + outer_keys
+    inner_keys = [E.Col(value_col)] + inner_keys
+    cond = _join_condition(residual, plan.schema.names,
+                           stripped.schema.names)
+    how = "left_anti" if isq.negated else "left_semi"
+    return L.Join(plan, stripped, how, tuple(outer_keys),
+                  tuple(inner_keys), cond)
+
+
+def _apply_scalar(
+    plan: L.LogicalPlan, sq: E.ScalarSubquery,
+) -> Tuple[L.LogicalPlan, E.Expression]:
+    """Returns (new_plan, replacement column expr)."""
+    i = next(_sq_counter)
+    out_name = f"__sq{i}"
+    sub = rewrite_subqueries(sq.plan)
+    stripped, corr, _ = _strip_correlated(sub)
+    if not corr:
+        first = stripped.schema.names[0]
+        renamed = L.Project((E.Alias(E.Col(first), out_name),), stripped)
+        return L.Join(plan, renamed, "cross", (), ()), E.Col(out_name)
+    # correlated: the top of the subquery must be a global aggregate;
+    # group it by the correlation columns and LEFT JOIN on them
+    # (reference: RewriteCorrelatedScalarSubquery + constructLeftJoins)
+    if not (isinstance(stripped, L.Aggregate) and not stripped.groupings
+            and len(stripped.aggregates) == 1):
+        raise NotImplementedError(
+            "correlated scalar subquery must be a single global aggregate")
+    outer_keys, inner_keys, residual = _corr_to_keys(corr)
+    if residual:
+        raise NotImplementedError(
+            "non-equality correlation in scalar subquery")
+    key_aliases = [E.Alias(k, f"__sqk{i}_{j}")
+                   for j, k in enumerate(inner_keys)]
+    agg_out = E.Alias(E.strip_alias(stripped.aggregates[0]), out_name)
+    grouped = L.Aggregate(tuple(inner_keys),
+                          tuple(key_aliases) + (agg_out,),
+                          stripped.child)
+    joined = L.Join(plan, grouped, "left", tuple(outer_keys),
+                    tuple(E.Col(a.alias_name) for a in key_aliases))
+    return joined, E.Col(out_name)
+
+
+def _rewrite_filter(node: L.Filter) -> L.LogicalPlan:
+    base_names = node.child.schema.names
+    plan = node.child
+    kept: List[E.Expression] = []
+    for c in _split(node.condition):
+        if isinstance(c, E.Exists):
+            plan = _apply_exists(plan, c)
+        elif isinstance(c, E.Not) and isinstance(c.child, E.Exists):
+            inner = c.child
+            plan = _apply_exists(plan, E.Exists(inner.plan,
+                                                not inner.negated))
+        elif isinstance(c, E.InSubquery):
+            plan = _apply_in(plan, c)
+        elif isinstance(c, E.Not) and isinstance(c.child, E.InSubquery):
+            inner = c.child
+            plan = _apply_in(plan, E.InSubquery(inner.child, inner.plan,
+                                                not inner.negated))
+        elif E.contains_subquery(c):
+            # scalar subqueries inside a comparison/expression
+            def replace(e: E.Expression) -> E.Expression:
+                nonlocal plan
+                if isinstance(e, E.ScalarSubquery):
+                    plan, col = _apply_scalar(plan, e)
+                    return col
+                if isinstance(e, (E.Exists, E.InSubquery)):
+                    raise NotImplementedError(
+                        "EXISTS/IN under OR or non-conjunct position")
+                return e
+
+            kept.append(E.transform_expr(c, replace))
+        else:
+            kept.append(c)
+    if kept:
+        plan = L.Filter(_combine(kept), plan)
+    if tuple(plan.schema.names) != tuple(base_names):
+        plan = L.Project(tuple(E.Col(n) for n in base_names), plan)
+    return plan
+
+
+def rewrite_subqueries(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Remove every SubqueryExpression (bottom-up; nested subqueries are
+    rewritten when their enclosing Filter is processed)."""
+
+    def fn(node: L.LogicalPlan) -> L.LogicalPlan:
+        if isinstance(node, L.Filter) and E.contains_subquery(node.condition):
+            return _rewrite_filter(node)
+        for e in node.expressions():
+            if E.contains_subquery(e):
+                raise NotImplementedError(
+                    f"subquery expression outside WHERE/HAVING: {e}")
+        return node
+
+    return plan.transform_up(fn)

@@ -1,0 +1,1059 @@
+"""SQL parser: text -> logical plans.
+
+Hand-written tokenizer + Pratt expression parser + statement builder,
+covering the dialect the engine executes: SELECT/FROM/WHERE/GROUP BY/
+HAVING/ORDER BY/LIMIT, explicit and comma joins, subqueries (FROM,
+scalar, IN, EXISTS — with correlation via OuterRef), CASE, BETWEEN,
+IN, LIKE, CAST, EXTRACT, date/interval literals, set operations, and
+CREATE/DROP VIEW. The reference parses with a 1,819-line ANTLR grammar
+(reference: sql/catalyst/src/main/antlr4/.../SqlBaseParser.g4:1 +
+parser/AstBuilder.scala); name resolution here happens during parsing
+against the FROM-clause scope, folding the Analyzer's resolution tier
+(reference: analysis/Analyzer.scala:188) into plan construction.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from spark_tpu import types as T
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+from spark_tpu.sql.ddl import parse_type
+
+# ---- tokenizer --------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qid>"[^"]*"|`[^`]*`)
+  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+  | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+""", re.VERBOSE | re.DOTALL)
+
+
+@dataclass
+class Token:
+    kind: str  # 'num' | 'str' | 'id' | 'qid' | 'op' | 'eof'
+    value: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+def tokenize(text: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    n = len(text)
+    while pos < n:
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise SQLParseError(
+                f"unexpected character {text[pos]!r} at {pos}: "
+                f"...{text[max(0, pos - 20):pos + 20]}...")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        val = m.group()
+        if kind == "qid":
+            val = val[1:-1]
+        out.append(Token(kind, val, m.start()))
+    out.append(Token("eof", "", n))
+    return out
+
+
+class SQLParseError(ValueError):
+    pass
+
+
+_RESERVED_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "ON",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "OUTER", "UNION",
+    "INTERSECT", "EXCEPT", "AS", "AND", "OR", "NOT", "BY", "ASC", "DESC",
+    "THEN", "WHEN", "ELSE", "END", "USING", "SEMI", "ANTI", "NULLS",
+}
+
+
+# ---- name resolution scope --------------------------------------------------
+
+
+class Scope:
+    """FROM-clause namespace: per-alias source->output column mapping.
+
+    Join output names deduplicate with '#2' suffixes (logical.Join.schema
+    semantics); the scope tracks, for every relation in the FROM clause,
+    what each of its columns is called in the joined output, so
+    ``alias.col`` and bare ``col`` resolve to output Col names."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.entries: List[Tuple[Optional[str], List[Tuple[str, str]]]] = []
+        self.parent = parent
+
+    def add_relation(self, alias: Optional[str],
+                     src_names: Sequence[str]) -> List[str]:
+        """Register a relation; returns the OUTPUT names its columns get
+        after join-dedup against everything already in scope."""
+        seen = {out for _, cols in self.entries for _, out in cols}
+        mapping = []
+        for n in src_names:
+            out = n
+            while out in seen:
+                out = out + "#2"
+            seen.add(out)
+            mapping.append((n, out))
+        self.entries.append((alias.lower() if alias else None, mapping))
+        return [out for _, out in mapping]
+
+    def resolve(self, qualifier: Optional[str], name: str) -> Optional[str]:
+        name_l = name.lower()
+        if qualifier is not None:
+            q = qualifier.lower()
+            for alias, cols in self.entries:
+                if alias == q:
+                    for src, out in cols:
+                        if src.lower() == name_l:
+                            return out
+            return None
+        hits = []
+        for _, cols in self.entries:
+            for src, out in cols:
+                if src.lower() == name_l:
+                    hits.append(out)
+        if len(hits) > 1:
+            raise SQLParseError(f"ambiguous column reference {name!r}")
+        return hits[0] if hits else None
+
+    def all_output_names(self) -> List[str]:
+        return [out for _, cols in self.entries for _, out in cols]
+
+    def relation_outputs(self, alias: str) -> Optional[List[str]]:
+        q = alias.lower()
+        for a, cols in self.entries:
+            if a == q:
+                return [out for _, out in cols]
+        return None
+
+
+# ---- expression parser (Pratt) ----------------------------------------------
+
+Resolver = Callable[[Optional[str], str], E.Expression]
+
+
+class _ExprParser:
+    def __init__(self, tokens: List[Token], pos: int, resolver: Resolver,
+                 subquery_parser=None):
+        self.toks = tokens
+        self.pos = pos
+        self.resolve = resolver
+        self.subquery_parser = subquery_parser  # parses ( SELECT ... )
+
+    # -- token helpers -------------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.pos + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:  # noqa: A003
+        t = self.toks[self.pos]
+        self.pos += 1
+        return t
+
+    def accept(self, *values: str) -> Optional[Token]:
+        t = self.peek()
+        if t.kind in ("id", "op") and t.upper in values:
+            return self.next()
+        return None
+
+    def expect(self, value: str) -> Token:
+        t = self.next()
+        if t.upper != value:
+            raise SQLParseError(
+                f"expected {value!r}, found {t.value!r} at {t.pos}")
+        return t
+
+    def at_keyword(self, *values: str) -> bool:
+        t = self.peek()
+        return t.kind == "id" and t.upper in values
+
+    # -- grammar -------------------------------------------------------------
+
+    def parse(self) -> E.Expression:
+        return self.parse_or()
+
+    def parse_or(self) -> E.Expression:
+        left = self.parse_and()
+        while self.accept("OR"):
+            left = E.Or(left, self.parse_and())
+        return left
+
+    def parse_and(self) -> E.Expression:
+        left = self.parse_not()
+        while self.accept("AND"):
+            left = E.And(left, self.parse_not())
+        return left
+
+    def parse_not(self) -> E.Expression:
+        if self.accept("NOT"):
+            inner = self.parse_not()
+            if isinstance(inner, E.Exists):
+                return E.Exists(inner.plan, not inner.negated)
+            return E.Not(inner)
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> E.Expression:
+        if self.at_keyword("EXISTS"):
+            self.next()
+            self.expect("(")
+            plan = self.subquery_parser(self)
+            self.expect(")")
+            return E.Exists(plan)
+        left = self.parse_additive()
+        negated = bool(self.accept("NOT"))
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "==", "<>", "!=", "<", "<=",
+                                          ">", ">=") and not negated:
+            op = self.next().value
+            op = {"=": "==", "<>": "!="}.get(op, op)
+            right = self.parse_additive()
+            return E.Cmp(op, left, right)
+        if self.accept("BETWEEN"):
+            lo = self.parse_additive()
+            self.expect("AND")
+            hi = self.parse_additive()
+            e: E.Expression = E.And(E.Cmp(">=", left, lo),
+                                    E.Cmp("<=", left, hi))
+            return E.Not(e) if negated else e
+        if self.accept("IN"):
+            self.expect("(")
+            if self.at_keyword("SELECT", "WITH"):
+                plan = self.subquery_parser(self)
+                self.expect(")")
+                return E.InSubquery(left, plan, negated)
+            values = [self._literal_value(self.parse_additive())]
+            while self.accept(","):
+                values.append(self._literal_value(self.parse_additive()))
+            self.expect(")")
+            e = E.In(left, tuple(values))
+            return E.Not(e) if negated else e
+        if self.accept("LIKE"):
+            pat = self.next()
+            if pat.kind != "str":
+                raise SQLParseError(f"LIKE needs a string pattern at {pat.pos}")
+            e = E.Like(left, _unquote(pat.value))
+            return E.Not(e) if negated else e
+        if self.accept("IS"):
+            neg2 = bool(self.accept("NOT"))
+            self.expect("NULL")
+            e = E.IsNull(left)
+            return E.Not(e) if (neg2 != negated) else e
+        if negated:
+            raise SQLParseError(
+                f"dangling NOT before {self.peek().value!r}")
+        return left
+
+    def parse_additive(self) -> E.Expression:
+        left = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                self.next()
+                right = self.parse_multiplicative()
+                left = self._date_arith(t.value, left, right)
+            elif t.kind == "op" and t.value == "||":
+                self.next()
+                right = self.parse_multiplicative()
+                left = E.Concat((left, right))
+            else:
+                return left
+
+    def _date_arith(self, op: str, left: E.Expression,
+                    right: E.Expression) -> E.Expression:
+        """Fold interval literals into date arithmetic at parse time."""
+        if isinstance(right, _Interval):
+            if right.months:
+                months = right.months if op == "+" else -right.months
+                base = E.AddMonths(left, months)
+            else:
+                base = left
+            if right.days:
+                base = E.Arith(op, base, E.Literal(right.days))
+            return base
+        if isinstance(left, _Interval):
+            raise SQLParseError("interval must be the right operand")
+        return E.Arith(op, left, right)
+
+    def parse_multiplicative(self) -> E.Expression:
+        left = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                left = E.Arith(t.value, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> E.Expression:
+        t = self.peek()
+        if t.kind == "op" and t.value == "-":
+            self.next()
+            return E.Neg(self.parse_unary())
+        if t.kind == "op" and t.value == "+":
+            self.next()
+            return self.parse_unary()
+        return self.parse_primary()
+
+    # -- primaries -----------------------------------------------------------
+
+    def parse_primary(self) -> E.Expression:
+        t = self.next()
+        if t.kind == "num":
+            text = t.value
+            if "." in text or "e" in text.lower():
+                return E.Literal(float(text))
+            return E.Literal(int(text))
+        if t.kind == "str":
+            return E.Literal(_unquote(t.value))
+        if t.kind == "op" and t.value == "(":
+            if self.at_keyword("SELECT", "WITH"):
+                plan = self.subquery_parser(self)
+                self.expect(")")
+                return E.ScalarSubquery(plan)
+            e = self.parse()
+            self.expect(")")
+            return e
+        if t.kind in ("id", "qid"):
+            return self._parse_identifier(t)
+        raise SQLParseError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def _parse_identifier(self, t: Token) -> E.Expression:
+        u = t.upper if t.kind == "id" else None
+        if u == "NULL":
+            return E.Literal(None, T.BOOLEAN)
+        if u == "TRUE":
+            return E.Literal(True)
+        if u == "FALSE":
+            return E.Literal(False)
+        if u == "DATE" and self.peek().kind == "str":
+            s = _unquote(self.next().value)
+            return E.Literal(datetime.date.fromisoformat(s))
+        if u == "TIMESTAMP" and self.peek().kind == "str":
+            s = _unquote(self.next().value)
+            return E.Literal(datetime.datetime.fromisoformat(s))
+        if u == "INTERVAL":
+            return self._parse_interval()
+        if u == "CASE":
+            return self._parse_case()
+        if u == "CAST":
+            self.expect("(")
+            e = self.parse()
+            self.expect("AS")
+            type_toks = []
+            depth = 0
+            while True:
+                nt = self.peek()
+                if nt.kind == "op" and nt.value == "(":
+                    depth += 1
+                if nt.kind == "op" and nt.value == ")":
+                    if depth == 0:
+                        break
+                    depth -= 1
+                type_toks.append(self.next().value)
+            self.expect(")")
+            return E.Cast(e, parse_type(" ".join(type_toks)))
+        if u == "EXTRACT":
+            self.expect("(")
+            part = self.next().value.lower()
+            self.expect("FROM")
+            e = self.parse()
+            self.expect(")")
+            return E.ExtractDatePart(part, e)
+        # function call?
+        nxt = self.peek()
+        if nxt.kind == "op" and nxt.value == "(":
+            return self._parse_function(t)
+        # [qualifier .] column
+        if nxt.kind == "op" and nxt.value == "." and \
+                self.peek(1).kind in ("id", "qid"):
+            self.next()
+            col = self.next()
+            return self.resolve(t.value, col.value)
+        return self.resolve(None, t.value)
+
+    def _parse_interval(self) -> "_Interval":
+        t = self.next()
+        if t.kind == "str":
+            qty = int(_unquote(t.value))
+        elif t.kind == "num":
+            qty = int(t.value)
+        else:
+            raise SQLParseError(f"bad interval quantity at {t.pos}")
+        unit = self.next().upper.rstrip("S")
+        if unit == "YEAR":
+            return _Interval(months=12 * qty)
+        if unit == "MONTH":
+            return _Interval(months=qty)
+        if unit == "DAY":
+            return _Interval(days=qty)
+        if unit == "WEEK":
+            return _Interval(days=7 * qty)
+        raise SQLParseError(f"unsupported interval unit {unit!r}")
+
+    def _parse_case(self) -> E.Expression:
+        branches = []
+        operand = None
+        if not self.at_keyword("WHEN"):
+            operand = self.parse()
+        while self.accept("WHEN"):
+            cond = self.parse()
+            if operand is not None:
+                cond = E.Cmp("==", operand, cond)
+            self.expect("THEN")
+            val = self.parse()
+            branches.append((cond, val))
+        else_v = None
+        if self.accept("ELSE"):
+            else_v = self.parse()
+        self.expect("END")
+        return E.Case(tuple(branches), else_v)
+
+    _AGG_FNS = {"SUM": E.Sum, "AVG": E.Avg, "MIN": E.Min, "MAX": E.Max}
+
+    def _parse_function(self, name_tok: Token) -> E.Expression:
+        name = name_tok.upper
+        self.expect("(")
+        if name == "COUNT":
+            if self.peek().kind == "op" and self.peek().value == "*":
+                self.next()
+                self.expect(")")
+                return E.Count(None)
+            distinct = bool(self.accept("DISTINCT"))
+            e = self.parse()
+            self.expect(")")
+            return E.Count(e, distinct=distinct)
+        if name in self._AGG_FNS:
+            distinct = bool(self.accept("DISTINCT"))
+            e = self.parse()
+            self.expect(")")
+            cls = self._AGG_FNS[name]
+            if name in ("MIN", "MAX"):
+                return cls(e)
+            return cls(e, distinct=distinct)
+        if name in ("STDDEV", "STDDEV_SAMP", "STDDEV_POP", "VARIANCE",
+                    "VAR_SAMP", "VAR_POP"):
+            e = self.parse()
+            self.expect(")")
+            kind = {"STDDEV": "stddev_samp", "VARIANCE": "var_samp"}.get(
+                name, name.lower())
+            return E.StddevVariance(kind, e)
+        if name == "SUBSTRING" or name == "SUBSTR":
+            e = self.parse()
+            if self.accept("FROM"):
+                pos = self._int_literal()
+                self.expect("FOR")
+                length = self._int_literal()
+            else:
+                self.expect(",")
+                pos = self._int_literal()
+                self.expect(",")
+                length = self._int_literal()
+            self.expect(")")
+            return E.Substring(e, pos, length)
+        if name == "COALESCE":
+            args = [self.parse()]
+            while self.accept(","):
+                args.append(self.parse())
+            self.expect(")")
+            return E.Coalesce(tuple(args))
+        if name in ("YEAR", "MONTH", "DAY", "DAYOFMONTH"):
+            e = self.parse()
+            self.expect(")")
+            part = {"DAYOFMONTH": "day"}.get(name, name.lower())
+            return E.ExtractDatePart(part, e)
+        if name == "ABS":
+            e = self.parse()
+            self.expect(")")
+            return E.Abs(e)
+        if name == "NULLIF":
+            a = self.parse()
+            self.expect(",")
+            b = self.parse()
+            self.expect(")")
+            return E.Case(((E.Cmp("==", a, b), E.Literal(None, T.BOOLEAN)),),
+                          a)
+        if name == "CONCAT":
+            args = [self.parse()]
+            while self.accept(","):
+                args.append(self.parse())
+            self.expect(")")
+            return E.Concat(tuple(args))
+        if name in ("DATE_ADD", "DATE_SUB"):
+            e = self.parse()
+            self.expect(",")
+            d = self._int_literal()
+            self.expect(")")
+            op = "+" if name == "DATE_ADD" else "-"
+            return E.Arith(op, e, E.Literal(d))
+        if name == "ADD_MONTHS":
+            e = self.parse()
+            self.expect(",")
+            m = self._int_literal()
+            self.expect(")")
+            return E.AddMonths(e, m)
+        raise SQLParseError(f"unknown function {name_tok.value!r} "
+                            f"at {name_tok.pos}")
+
+    def _int_literal(self) -> int:
+        e = self.parse_unary()
+        if isinstance(e, E.Literal) and isinstance(e.value, int):
+            return e.value
+        if isinstance(e, E.Neg) and isinstance(e.child, E.Literal):
+            return -e.child.value
+        raise SQLParseError("expected integer literal")
+
+    @staticmethod
+    def _literal_value(e: E.Expression):
+        if isinstance(e, E.Literal):
+            return e.value
+        if isinstance(e, E.Neg) and isinstance(e.child, E.Literal):
+            return -e.child.value
+        raise SQLParseError("IN list supports literals only")
+
+
+@dataclass
+class _Interval(E.Expression):
+    months: int = 0
+    days: int = 0
+
+
+def _unquote(s: str) -> str:
+    return s[1:-1].replace("''", "'")
+
+
+# ---- statement parser -------------------------------------------------------
+
+
+class _StmtParser:
+    """Parses one statement; ``catalog`` resolves table names, the
+    optional ``outer`` scope enables correlated subqueries (inner lookups
+    that miss fall through to the outer scope as OuterRef)."""
+
+    def __init__(self, tokens: List[Token], pos: int, catalog,
+                 outer: Optional[Scope] = None,
+                 outer_schema=None):
+        self.toks = tokens
+        self.pos = pos
+        self.catalog = catalog
+        self.outer = outer
+        self.outer_schema = outer_schema
+
+    # token helpers shared with the expression parser via a tiny shim
+    def _ep(self, resolver: Resolver) -> _ExprParser:
+        ep = _ExprParser(self.toks, self.pos, resolver,
+                         subquery_parser=self._parse_subquery_in_expr)
+        return ep
+
+    def _sync(self, ep: _ExprParser):
+        self.pos = ep.pos
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.pos + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:  # noqa: A003
+        t = self.toks[self.pos]
+        self.pos += 1
+        return t
+
+    def accept(self, *values: str) -> Optional[Token]:
+        t = self.peek()
+        if t.kind in ("id", "op") and t.upper in values:
+            return self.next()
+        return None
+
+    def expect(self, value: str) -> Token:
+        t = self.next()
+        if t.upper != value:
+            raise SQLParseError(
+                f"expected {value!r}, found {t.value!r} at {t.pos}")
+        return t
+
+    def at_keyword(self, *values: str) -> bool:
+        t = self.peek()
+        return t.kind == "id" and t.upper in values
+
+    # -- subquery hook from expression context -------------------------------
+
+    def _parse_subquery_in_expr(self, ep: _ExprParser):
+        """Called by the expression parser at '( SELECT'. The CURRENT
+        query's scope becomes the subquery's outer scope."""
+        sub = _StmtParser(self.toks, ep.pos, self.catalog,
+                          outer=self._current_scope,
+                          outer_schema=self._current_plan_schema)
+        plan = sub.parse_query_body()
+        ep.pos = sub.pos
+        return plan
+
+    # -- resolvers ------------------------------------------------------------
+
+    def _make_resolver(self, scope: Scope, plan_schema) -> Resolver:
+        def resolve(qual: Optional[str], name: str) -> E.Expression:
+            out = scope.resolve(qual, name)
+            if out is not None:
+                return E.Col(out)
+            if self.outer is not None:
+                out2 = self.outer.resolve(qual, name)
+                if out2 is not None:
+                    dtype = (self.outer_schema.field(out2).dtype
+                             if self.outer_schema is not None
+                             and out2 in self.outer_schema else None)
+                    return E.OuterRef(out2, dtype)
+            raise SQLParseError(
+                f"cannot resolve column {qual + '.' if qual else ''}{name}")
+
+        return resolve
+
+    # -- FROM clause ----------------------------------------------------------
+
+    def _parse_relation_primary(self, scope: Scope):
+        """table [alias] | ( subquery ) alias — returns (plan, alias)."""
+        if self.accept("("):
+            sub = _StmtParser(self.toks, self.pos, self.catalog,
+                              outer=self.outer,
+                              outer_schema=self.outer_schema)
+            plan = sub.parse_query_body()
+            self.pos = sub.pos
+            self.expect(")")
+            alias = self._parse_alias()
+            return plan, alias
+        t = self.next()
+        if t.kind not in ("id", "qid"):
+            raise SQLParseError(f"expected table name at {t.pos}")
+        plan = self.catalog.lookup(t.value)
+        alias = self._parse_alias() or t.value
+        return plan, alias
+
+    def _parse_alias(self) -> Optional[str]:
+        if self.accept("AS"):
+            return self.next().value
+        t = self.peek()
+        if t.kind in ("id", "qid") and (t.kind == "qid"
+                                        or t.upper not in _RESERVED_STOP):
+            return self.next().value
+        return None
+
+    def _parse_from(self) -> Tuple[L.LogicalPlan, Scope]:
+        scope = Scope()
+        plan, alias = self._parse_relation_primary(scope)
+        scope.add_relation(alias, plan.schema.names)
+        while True:
+            if self.accept(","):
+                rplan, ralias = self._parse_relation_primary(scope)
+                scope.add_relation(ralias, rplan.schema.names)
+                plan = L.Join(plan, rplan, "cross", (), ())
+                continue
+            how = self._peek_join_type()
+            if how is None:
+                break
+            rplan, ralias = self._parse_relation_primary(scope)
+            right_src = rplan.schema.names
+            # output names the right side will take post-dedup
+            out_names = scope.add_relation(ralias, right_src)
+            right_sub = {out: src for out, src in zip(out_names, right_src)}
+            if self.accept("ON"):
+                resolver = self._make_resolver(scope, None)
+                ep = self._ep(resolver)
+                cond = ep.parse()
+                self._sync(ep)
+                plan = self._build_join(plan, rplan, how, cond, right_sub)
+            elif self.accept("USING"):
+                self.expect("(")
+                cols = [self.next().value]
+                while self.accept(","):
+                    cols.append(self.next().value)
+                self.expect(")")
+                lk = tuple(E.Col(c) for c in cols)
+                plan = L.Join(plan, rplan, how, lk, lk)
+            else:
+                if how != "cross":
+                    raise SQLParseError("JOIN requires ON or USING")
+                plan = L.Join(plan, rplan, "cross", (), ())
+        return plan, scope
+
+    def _peek_join_type(self) -> Optional[str]:
+        mapping = [
+            (("CROSS", "JOIN"), "cross"),
+            (("INNER", "JOIN"), "inner"),
+            (("LEFT", "SEMI", "JOIN"), "left_semi"),
+            (("LEFT", "ANTI", "JOIN"), "left_anti"),
+            (("LEFT", "OUTER", "JOIN"), "left"),
+            (("LEFT", "JOIN"), "left"),
+            (("RIGHT", "OUTER", "JOIN"), "right"),
+            (("RIGHT", "JOIN"), "right"),
+            (("FULL", "OUTER", "JOIN"), "full"),
+            (("FULL", "JOIN"), "full"),
+            (("JOIN",), "inner"),
+        ]
+        for words, how in mapping:
+            if all(self.peek(i).upper == w for i, w in enumerate(words)):
+                for _ in words:
+                    self.next()
+                return how
+        return None
+
+    def _build_join(self, left: L.LogicalPlan, right: L.LogicalPlan,
+                    how: str, cond: E.Expression,
+                    right_out_to_src: Dict[str, str]) -> L.LogicalPlan:
+        """Split an ON condition into equi keys + residual. The condition
+        references OUTPUT names; keys must be rewritten to each side's
+        SOURCE names (the engines evaluate keys on child pipes)."""
+        from spark_tpu.plan.optimizer import (combine_conjuncts,
+                                              split_conjuncts)
+
+        left_out = set(left.schema.names)
+        right_out = set(right_out_to_src)
+
+        def to_src(e: E.Expression) -> E.Expression:
+            def fn(x):
+                if isinstance(x, E.Col) and x.col_name in right_out_to_src:
+                    return E.Col(right_out_to_src[x.col_name])
+                return x
+
+            return E.transform_expr(e, fn)
+
+        lkeys: List[E.Expression] = []
+        rkeys: List[E.Expression] = []
+        residual: List[E.Expression] = []
+        for c in split_conjuncts(cond):
+            if isinstance(c, E.Cmp) and c.op == "==":
+                lr, rr = c.left.references(), c.right.references()
+                if lr and lr <= left_out and rr and rr <= right_out:
+                    lkeys.append(c.left)
+                    rkeys.append(to_src(c.right))
+                    continue
+                if rr and rr <= left_out and lr and lr <= right_out:
+                    lkeys.append(c.right)
+                    rkeys.append(to_src(c.left))
+                    continue
+            residual.append(c)
+        res = combine_conjuncts(residual) if residual else None
+        return L.Join(left, right, how, tuple(lkeys), tuple(rkeys), res)
+
+    # -- SELECT core -----------------------------------------------------------
+
+    def parse_query_body(self) -> L.LogicalPlan:
+        """query := select_core (UNION [ALL] | INTERSECT | EXCEPT
+        select_core)* [ORDER BY ...] [LIMIT n]"""
+        plan = self.parse_select_core()
+        while True:
+            if self.accept("UNION"):
+                all_ = bool(self.accept("ALL"))
+                rhs = self.parse_select_core()
+                plan = L.Union(plan, rhs)
+                if not all_:
+                    plan = L.Distinct(plan)
+            elif self.accept("INTERSECT"):
+                rhs = self.parse_select_core()
+                cols = tuple(E.Col(n) for n in plan.schema.names)
+                rcols = tuple(E.Col(n) for n in rhs.schema.names)
+                plan = L.Distinct(
+                    L.Join(plan, rhs, "left_semi", cols, rcols))
+            elif self.accept("EXCEPT"):
+                rhs = self.parse_select_core()
+                cols = tuple(E.Col(n) for n in plan.schema.names)
+                rcols = tuple(E.Col(n) for n in rhs.schema.names)
+                plan = L.Distinct(
+                    L.Join(plan, rhs, "left_anti", cols, rcols))
+            else:
+                break
+        plan = self._parse_order_limit(plan)
+        return plan
+
+    def _parse_order_limit(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        if self.at_keyword("ORDER"):
+            self.next()
+            self.expect("BY")
+            out_names = set(plan.schema.names)
+
+            def resolve(qual, name):
+                if name in out_names or qual is None:
+                    if name not in out_names:
+                        # case-insensitive fallback
+                        for n in out_names:
+                            if n.lower() == name.lower():
+                                return E.Col(n)
+                        raise SQLParseError(
+                            f"ORDER BY column {name!r} is not in the "
+                            f"select list output {sorted(out_names)}")
+                    return E.Col(name)
+                raise SQLParseError(f"cannot resolve {qual}.{name}")
+
+            orders = []
+            while True:
+                ep = self._ep(resolve)
+                e = ep.parse()
+                self._sync(ep)
+                asc = True
+                if self.accept("DESC"):
+                    asc = False
+                elif self.accept("ASC"):
+                    pass
+                nulls_first = None
+                if self.accept("NULLS"):
+                    nf = self.next().upper
+                    nulls_first = nf == "FIRST"
+                orders.append(E.SortOrder(e, asc, nulls_first))
+                if not self.accept(","):
+                    break
+            plan = L.Sort(tuple(orders), plan)
+        if self.at_keyword("LIMIT"):
+            self.next()
+            n = int(self.next().value)
+            offset = 0
+            if self.at_keyword("OFFSET"):
+                self.next()
+                offset = int(self.next().value)
+            plan = L.Limit(n, plan, offset=offset)
+        return plan
+
+    def parse_select_core(self) -> L.LogicalPlan:
+        self.expect("SELECT")
+        distinct = bool(self.accept("DISTINCT"))
+        self.accept("ALL")
+
+        # select list is parsed AFTER from (resolution needs the scope),
+        # so remember its token span and skip ahead to FROM
+        select_start = self.pos
+        depth = 0
+        while True:
+            t = self.peek()
+            if t.kind == "eof":
+                break
+            if t.kind == "op" and t.value == "(":
+                depth += 1
+            elif t.kind == "op" and t.value == ")":
+                if depth == 0:
+                    break
+                depth -= 1
+            elif depth == 0 and t.kind == "id" and t.upper == "FROM":
+                break
+            self.next()
+        select_end = self.pos
+
+        if self.at_keyword("FROM"):
+            self.next()
+            plan, scope = self._parse_from()
+        else:
+            # SELECT without FROM: single-row relation
+            plan, scope = L.Range(0, 1, 1, "__one"), Scope()
+
+        self._current_scope = scope
+        self._current_plan_schema = plan.schema
+        resolver = self._make_resolver(scope, plan.schema)
+
+        # WHERE
+        if self.accept("WHERE"):
+            ep = self._ep(resolver)
+            cond = ep.parse()
+            self._sync(ep)
+            plan = L.Filter(cond, plan)
+            self._current_plan_schema = plan.schema
+
+        # parse the saved select list now
+        saved = self.pos
+        self.pos = select_start
+        select_exprs = self._parse_select_list(select_end, scope, resolver)
+        self.pos = saved
+
+        # GROUP BY / HAVING / aggregate detection
+        group_exprs: List[E.Expression] = []
+        if self.at_keyword("GROUP"):
+            self.next()
+            self.expect("BY")
+            while True:
+                ep = self._ep(self._group_resolver(resolver, select_exprs))
+                e = ep.parse()
+                self._sync(ep)
+                group_exprs.append(E.strip_alias(e))
+                if not self.accept(","):
+                    break
+        having = None
+        if self.at_keyword("HAVING"):
+            self.next()
+            ep = self._ep(resolver)
+            having = ep.parse()
+            self._sync(ep)
+
+        has_agg = any(E.contains_aggregate(e) for e in select_exprs)
+        if group_exprs or has_agg or having is not None:
+            outputs = list(select_exprs)
+            having_cond = None
+            if having is not None:
+                # pull aggregate calls out of the predicate as hidden
+                # outputs so HAVING becomes an ordinary Filter above the
+                # Aggregate (where subquery rewriting can reach it);
+                # project the hidden columns away afterwards
+                hidden: List[E.Alias] = []
+                seen_aggs: Dict[tuple, str] = {}
+
+                def pull(e: E.Expression) -> E.Expression:
+                    if isinstance(e, E.AggregateExpression):
+                        sk = E.expr_key(e)
+                        if sk not in seen_aggs:
+                            name = f"__h{len(hidden)}"
+                            seen_aggs[sk] = name
+                            hidden.append(E.Alias(e, name))
+                        return E.Col(seen_aggs[sk])
+                    return e
+
+                having_cond = E.transform_expr(having, pull)
+                outputs = outputs + hidden
+            plan = L.Aggregate(tuple(group_exprs), tuple(outputs), plan)
+            if having_cond is not None:
+                plan = L.Filter(having_cond, plan)
+                plan = L.Project(
+                    tuple(E.Col(e.name) for e in select_exprs), plan)
+        else:
+            plan = L.Project(tuple(select_exprs), plan)
+
+        if distinct:
+            plan = L.Distinct(plan)
+        return plan
+
+    def _group_resolver(self, resolver: Resolver,
+                        select_exprs: List[E.Expression]) -> Resolver:
+        """GROUP BY may name a select alias (GROUP BY revenue)."""
+        by_alias = {e.name: E.strip_alias(e) for e in select_exprs
+                    if isinstance(e, E.Alias)}
+
+        def resolve(qual, name):
+            try:
+                return resolver(qual, name)
+            except SQLParseError:
+                if qual is None and name in by_alias:
+                    return by_alias[name]
+                raise
+
+        return resolve
+
+    def _parse_select_list(self, end: int, scope: Scope,
+                           resolver: Resolver) -> List[E.Expression]:
+        exprs: List[E.Expression] = []
+        while self.pos < end:
+            t = self.peek()
+            if t.kind == "op" and t.value == "*":
+                self.next()
+                exprs.extend(E.Col(n) for n in scope.all_output_names())
+            elif t.kind in ("id", "qid") and self.peek(1).value == "." \
+                    and self.peek(2).value == "*":
+                rel_outs = scope.relation_outputs(t.value)
+                if rel_outs is None:
+                    raise SQLParseError(f"unknown relation {t.value!r}")
+                self.next()
+                self.next()
+                self.next()
+                exprs.extend(E.Col(n) for n in rel_outs)
+            else:
+                ep = self._ep(resolver)
+                e = ep.parse()
+                self._sync(ep)
+                if self.pos < end and self.accept("AS"):
+                    e = E.Alias(e, self.next().value)
+                elif self.pos < end and self.peek().kind in ("id", "qid") \
+                        and self.peek().upper not in _RESERVED_STOP:
+                    e = E.Alias(e, self.next().value)
+                exprs.append(e)
+            if self.pos < end:
+                if not self.accept(","):
+                    raise SQLParseError(
+                        f"expected ',' in select list at "
+                        f"{self.peek().pos}: {self.peek().value!r}")
+        return exprs
+
+
+# ---- public entry points ----------------------------------------------------
+
+
+class _NoCatalog:
+    def lookup(self, name: str):
+        raise SQLParseError(
+            f"table or view not found: {name} (no catalog in scope)")
+
+
+def parse_sql(query: str, catalog=None) -> L.LogicalPlan:
+    """Parse a full statement: SELECT query, CREATE/DROP VIEW."""
+    toks = tokenize(query)
+    p = _StmtParser(toks, 0, catalog if catalog is not None else _NoCatalog())
+
+    if p.at_keyword("CREATE"):
+        p.next()
+        p.accept("OR")
+        p.accept("REPLACE")
+        p.accept("TEMP")
+        p.accept("TEMPORARY")
+        p.expect("VIEW")
+        name = p.next().value
+        p.expect("AS")
+        plan = p.parse_query_body()
+        catalog._register_view(name, plan)
+        return L.Range(0, 0, 1, "__ok")  # DDL: empty result
+    if p.at_keyword("DROP"):
+        p.next()
+        p.expect("VIEW")
+        name = p.next().value
+        catalog.dropTempView(name)
+        return L.Range(0, 0, 1, "__ok")
+
+    plan = p.parse_query_body()
+    t = p.peek()
+    if not (t.kind == "eof" or (t.kind == "op" and t.value == ";")):
+        raise SQLParseError(f"trailing input at {t.pos}: {t.value!r}")
+    from spark_tpu.plan.subquery import rewrite_subqueries
+
+    return rewrite_subqueries(plan)
+
+
+def _schema_resolver(schema) -> Resolver:
+    def resolve(qual: Optional[str], name: str) -> E.Expression:
+        if schema is not None and name not in schema:
+            for n in schema.names:
+                if n.lower() == name.lower():
+                    return E.Col(n)
+        return E.Col(name)
+
+    return resolve
+
+
+def parse_expression(text: str, schema=None) -> E.Expression:
+    """Parse a standalone SQL expression (df.filter("..."), F.expr)."""
+    toks = tokenize(text)
+    ep = _ExprParser(toks, 0, _schema_resolver(schema))
+    e = ep.parse()
+    t = ep.peek()
+    if t.kind != "eof":
+        raise SQLParseError(f"trailing input at {t.pos}: {t.value!r}")
+    return e
+
+
+def parse_projection(text: str, schema=None) -> E.Expression:
+    """Parse 'expr [AS alias]' (df.selectExpr)."""
+    toks = tokenize(text)
+    ep = _ExprParser(toks, 0, _schema_resolver(schema))
+    e = ep.parse()
+    t = ep.peek()
+    if t.kind == "id" and t.upper == "AS":
+        ep.next()
+        alias = ep.next().value
+        e = E.Alias(e, alias)
+        t = ep.peek()
+    elif t.kind in ("id", "qid") and t.upper not in _RESERVED_STOP:
+        ep.next()
+        e = E.Alias(e, t.value)
+        t = ep.peek()
+    if t.kind != "eof":
+        raise SQLParseError(f"trailing input at {t.pos}: {t.value!r}")
+    return e

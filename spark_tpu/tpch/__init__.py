@@ -1,0 +1,13 @@
+"""TPC-H: data generator, query texts, external-oracle harness.
+
+Mirrors the reference's TPC-H assets (reference:
+sql/core/src/test/resources/tpch/q1.sql..q22.sql and
+sql/core/src/test/scala/org/apache/spark/sql/TPCHQuerySuite.scala:26):
+the queries are written from the TPC-H specification, the generator is a
+spec-shaped vectorized numpy dbgen, and result parity is checked against
+sqlite3 (an independent SQL engine in the stdlib) instead of the
+project's own single-device mode.
+"""
+
+from spark_tpu.tpch.gen import generate_tables, write_parquet, register_views
+from spark_tpu.tpch.queries import QUERIES

@@ -1,0 +1,311 @@
+"""Vectorized TPC-H data generator (spec-shaped dbgen).
+
+Produces the eight TPC-H tables as Arrow tables with the spec's schema,
+key structure, value domains and the text patterns the 22 queries
+predicate on (Brand#MN, container/type vocabularies, p_name words,
+comment injections, phone country codes, date windows). Row counts and
+distributions follow the TPC-H specification section 4.2; text is
+simplified (random word sequences rather than the spec's grammar) except
+where queries match on it. Reference peer: the dbgen tool invoked by
+TPCHQuerySuite (reference: sql/core/.../TPCHQuerySuite.scala:26).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+EPOCH = datetime.date(1970, 1, 1)
+START = (datetime.date(1992, 1, 1) - EPOCH).days      # o_orderdate low
+END = (datetime.date(1998, 8, 2) - EPOCH).days        # o_orderdate high
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+# (nation, region index) — spec Table 4.2.3
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTIONS = ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                "TAKE BACK RETURN"]
+
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINER_S1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINER_S2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished",
+    "chartreuse", "chiffon", "chocolate", "coral", "cornflower", "cornsilk",
+    "cream", "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
+    "floral", "forest", "frosted", "gainsboro", "ghost", "goldenrod",
+    "green", "grey", "honeydew", "hot", "indian", "ivory", "khaki",
+    "lace", "lavender", "lawn", "lemon", "light", "lime", "linen",
+    "magenta", "maroon", "medium", "metallic", "midnight", "mint", "misty",
+    "moccasin", "navajo", "navy", "olive", "orange", "orchid", "pale",
+    "papaya", "peach", "peru", "pink", "plum", "powder", "puff", "purple",
+    "red", "rose", "rosy", "royal", "saddle", "salmon", "sandy", "seashell",
+    "sienna", "sky", "slate", "smoke", "snow", "spring", "steel", "tan",
+    "thistle", "tomato", "turquoise", "violet", "wheat", "white", "yellow",
+]
+
+_COMMENT_WORDS = np.array([
+    "carefully", "quickly", "furiously", "slyly", "blithely", "deposits",
+    "requests", "packages", "accounts", "instructions", "foxes", "ideas",
+    "theodolites", "pinto", "beans", "asymptotes", "dependencies", "somas",
+    "platelets", "sleep", "haggle", "nag", "wake", "cajole", "detect",
+    "integrate", "boost", "among", "final", "ironic", "express", "regular",
+    "bold", "even", "silent", "pending", "special", "unusual",
+])
+
+
+def _money(rng, n, lo, hi):
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def _words(rng, n: int, k: int) -> np.ndarray:
+    """k-word random comment strings."""
+    idx = rng.integers(0, len(_COMMENT_WORDS), (n, k))
+    parts = _COMMENT_WORDS[idx]
+    out = parts[:, 0]
+    for j in range(1, k):
+        out = np.char.add(np.char.add(out, " "), parts[:, j])
+    return out
+
+
+def _pick(rng, n, values) -> np.ndarray:
+    return np.array(values)[rng.integers(0, len(values), n)]
+
+
+def generate_tables(sf: float = 0.01,
+                    seed: int = 20260729) -> Dict[str, pa.Table]:
+    """All eight tables at scale factor ``sf`` (sf=1 is ~6M lineitems)."""
+    rng = np.random.default_rng(seed)
+    tables: Dict[str, pa.Table] = {}
+
+    # region / nation --------------------------------------------------------
+    tables["region"] = pa.table({
+        "r_regionkey": pa.array(np.arange(5), pa.int64()),
+        "r_name": pa.array(REGIONS),
+        "r_comment": pa.array(list(_words(rng, 5, 6))),
+    })
+    tables["nation"] = pa.table({
+        "n_nationkey": pa.array(np.arange(25), pa.int64()),
+        "n_name": pa.array([n for n, _ in NATIONS]),
+        "n_regionkey": pa.array(np.array([r for _, r in NATIONS]),
+                                pa.int64()),
+        "n_comment": pa.array(list(_words(rng, 25, 8))),
+    })
+
+    # part --------------------------------------------------------------------
+    n_part = max(1, int(200_000 * sf))
+    pk = np.arange(1, n_part + 1)
+    name_idx = rng.integers(0, len(P_NAME_WORDS), (n_part, 5))
+    wl = np.array(P_NAME_WORDS)
+    p_name = wl[name_idx[:, 0]]
+    for j in range(1, 5):
+        p_name = np.char.add(np.char.add(p_name, " "), wl[name_idx[:, j]])
+    brand_m = rng.integers(1, 6, n_part)
+    brand_n = rng.integers(1, 6, n_part)
+    p_brand = np.char.add("Brand#", np.char.add(
+        brand_m.astype(str), brand_n.astype(str)))
+    p_type = np.char.add(np.char.add(np.char.add(
+        _pick(rng, n_part, TYPE_S1), " "),
+        np.char.add(_pick(rng, n_part, TYPE_S2), " ")),
+        _pick(rng, n_part, TYPE_S3))
+    p_container = np.char.add(np.char.add(
+        _pick(rng, n_part, CONTAINER_S1), " "),
+        _pick(rng, n_part, CONTAINER_S2))
+    # spec: (90000 + ((partkey/10) mod 20001) + 100*(partkey mod 1000))/100
+    p_retail = (90000 + (pk // 10) % 20001 + 100 * (pk % 1000)) / 100.0
+    tables["part"] = pa.table({
+        "p_partkey": pa.array(pk, pa.int64()),
+        "p_name": pa.array(list(p_name)),
+        "p_mfgr": pa.array(list(np.char.add("Manufacturer#",
+                                            brand_m.astype(str)))),
+        "p_brand": pa.array(list(p_brand)),
+        "p_type": pa.array(list(p_type)),
+        "p_size": pa.array(rng.integers(1, 51, n_part), pa.int32()),
+        "p_container": pa.array(list(p_container)),
+        "p_retailprice": pa.array(p_retail),
+        "p_comment": pa.array(list(_words(rng, n_part, 3))),
+    })
+
+    # supplier ----------------------------------------------------------------
+    n_supp = max(1, int(10_000 * sf))
+    sk = np.arange(1, n_supp + 1)
+    s_nation = rng.integers(0, 25, n_supp)
+    s_comment = _words(rng, n_supp, 8)
+    # q16: ~5 per 10k suppliers carry 'Customer...Complaints'
+    bad = rng.choice(n_supp, size=max(1, n_supp // 2000), replace=False)
+    s_comment[bad] = np.char.add(
+        np.char.add("Customer ", _words(rng, len(bad), 2)), " Complaints")
+    tables["supplier"] = pa.table({
+        "s_suppkey": pa.array(sk, pa.int64()),
+        "s_name": pa.array(["Supplier#%09d" % k for k in sk]),
+        "s_address": pa.array(list(_words(rng, n_supp, 3))),
+        "s_nationkey": pa.array(s_nation, pa.int64()),
+        "s_phone": pa.array(_phones(rng, s_nation)),
+        "s_acctbal": pa.array(_money(rng, n_supp, -999.99, 9999.99)),
+        "s_comment": pa.array(list(s_comment)),
+    })
+
+    # partsupp ----------------------------------------------------------------
+    ps_part = np.repeat(pk, 4)
+    ps_supp = np.empty(len(ps_part), dtype=np.int64)
+    for j in range(4):
+        # spec: supplier = (partkey + j*(S/4 + (partkey-1)//S)) % S + 1
+        ps_supp[j::4] = (pk + j * (n_supp // 4 + (pk - 1) // n_supp)) \
+            % n_supp + 1
+    tables["partsupp"] = pa.table({
+        "ps_partkey": pa.array(ps_part, pa.int64()),
+        "ps_suppkey": pa.array(ps_supp, pa.int64()),
+        "ps_availqty": pa.array(rng.integers(1, 10_000, len(ps_part)),
+                                pa.int32()),
+        "ps_supplycost": pa.array(_money(rng, len(ps_part), 1.0, 1000.0)),
+        "ps_comment": pa.array(list(_words(rng, len(ps_part), 5))),
+    })
+
+    # customer ----------------------------------------------------------------
+    n_cust = max(1, int(150_000 * sf))
+    ck = np.arange(1, n_cust + 1)
+    c_nation = rng.integers(0, 25, n_cust)
+    c_comment = _words(rng, n_cust, 6)
+    # q13: some customers' orders carry 'special ... requests' comments —
+    # handled on orders below
+    tables["customer"] = pa.table({
+        "c_custkey": pa.array(ck, pa.int64()),
+        "c_name": pa.array(["Customer#%09d" % k for k in ck]),
+        "c_address": pa.array(list(_words(rng, n_cust, 3))),
+        "c_nationkey": pa.array(c_nation, pa.int64()),
+        "c_phone": pa.array(_phones(rng, c_nation)),
+        "c_acctbal": pa.array(_money(rng, n_cust, -999.99, 9999.99)),
+        "c_mktsegment": pa.array(list(_pick(rng, n_cust, SEGMENTS))),
+        "c_comment": pa.array(list(c_comment)),
+    })
+
+    # orders ------------------------------------------------------------------
+    n_ord = max(1, int(1_500_000 * sf))
+    ok = np.arange(1, n_ord + 1)
+    # spec: only 2/3 of customers have orders
+    cust_with_orders = ck[ck % 3 != 0] if n_cust >= 3 else ck
+    o_cust = cust_with_orders[rng.integers(0, len(cust_with_orders), n_ord)]
+    o_date = rng.integers(START, END - 150, n_ord)
+    o_comment = _words(rng, n_ord, 5)
+    special = rng.random(n_ord) < 0.02
+    o_comment[special] = np.char.add(
+        np.char.add("special ", _words(rng, int(special.sum()), 2)),
+        " requests")
+    tables["orders"] = pa.table({
+        "o_orderkey": pa.array(ok, pa.int64()),
+        "o_custkey": pa.array(o_cust, pa.int64()),
+        "o_orderstatus": pa.array(list(_pick(rng, n_ord, ["O", "F", "P"]))),
+        "o_totalprice": pa.array(_money(rng, n_ord, 900.0, 450_000.0)),
+        "o_orderdate": pa.array(o_date.astype("int32"), pa.int32()).cast(
+            pa.date32()),
+        "o_orderpriority": pa.array(list(_pick(rng, n_ord, PRIORITIES))),
+        "o_clerk": pa.array(["Clerk#%09d" % c for c in
+                             rng.integers(1, max(2, n_ord // 1000),
+                                          n_ord)]),
+        "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int32),
+                                   pa.int32()),
+        "o_comment": pa.array(list(o_comment)),
+    })
+
+    # lineitem ----------------------------------------------------------------
+    lines_per = rng.integers(1, 8, n_ord)
+    l_order = np.repeat(ok, lines_per)
+    l_odate = np.repeat(o_date, lines_per)
+    n_li = len(l_order)
+    l_line = np.concatenate([np.arange(1, c + 1) for c in lines_per])
+    l_part = rng.integers(1, n_part + 1, n_li)
+    # supplier must be one of the part's 4 partsupp suppliers (q9 join)
+    which = rng.integers(0, 4, n_li)
+    l_supp = (l_part + which * (n_supp // 4 + (l_part - 1) // n_supp)) \
+        % n_supp + 1
+    l_qty = rng.integers(1, 51, n_li).astype(np.float64)
+    l_price = l_qty * p_retail[l_part - 1]
+    l_disc = np.round(rng.integers(0, 11, n_li) / 100.0, 2)
+    l_tax = np.round(rng.integers(0, 9, n_li) / 100.0, 2)
+    ship = l_odate + rng.integers(1, 122, n_li)
+    commit = l_odate + rng.integers(30, 91, n_li)
+    receipt = ship + rng.integers(1, 31, n_li)
+    today = (datetime.date(1995, 6, 17) - EPOCH).days
+    returnflag = np.where(
+        receipt <= today, _pick(rng, n_li, ["R", "A"]), "N")
+    linestatus = np.where(ship > today, "O", "F")
+    tables["lineitem"] = pa.table({
+        "l_orderkey": pa.array(l_order, pa.int64()),
+        "l_partkey": pa.array(l_part, pa.int64()),
+        "l_suppkey": pa.array(l_supp, pa.int64()),
+        "l_linenumber": pa.array(l_line, pa.int32()),
+        "l_quantity": pa.array(l_qty),
+        "l_extendedprice": pa.array(np.round(l_price, 2)),
+        "l_discount": pa.array(l_disc),
+        "l_tax": pa.array(l_tax),
+        "l_returnflag": pa.array(list(returnflag)),
+        "l_linestatus": pa.array(list(linestatus)),
+        "l_shipdate": pa.array(ship.astype("int32"), pa.int32()).cast(
+            pa.date32()),
+        "l_commitdate": pa.array(commit.astype("int32"), pa.int32()).cast(
+            pa.date32()),
+        "l_receiptdate": pa.array(receipt.astype("int32"), pa.int32()).cast(
+            pa.date32()),
+        "l_shipinstruct": pa.array(list(_pick(rng, n_li, INSTRUCTIONS))),
+        "l_shipmode": pa.array(list(_pick(rng, n_li, SHIPMODES))),
+        "l_comment": pa.array(list(_words(rng, n_li, 4))),
+    })
+    return tables
+
+
+def _phones(rng, nationkeys: np.ndarray):
+    """Spec phone format: 'CC-xxx-xxx-xxxx' with CC = nationkey + 10
+    (q22 matches on the country-code prefix)."""
+    cc = (nationkeys + 10).astype(str)
+    parts = [rng.integers(100, 1000, len(nationkeys)).astype(str),
+             rng.integers(100, 1000, len(nationkeys)).astype(str),
+             rng.integers(1000, 10_000, len(nationkeys)).astype(str)]
+    out = cc
+    for p in parts:
+        out = np.char.add(np.char.add(out, "-"), p)
+    return list(out)
+
+
+def write_parquet(tables: Dict[str, pa.Table], path: str) -> None:
+    import os
+
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    for name, tbl in tables.items():
+        pq.write_table(tbl, os.path.join(path, f"{name}.parquet"))
+
+
+def register_views(spark, tables: Optional[Dict[str, pa.Table]] = None,
+                   path: Optional[str] = None) -> None:
+    """Register the eight tables as temp views, from memory or a
+    write_parquet directory (the latter exercises the scan layer)."""
+    names = ["region", "nation", "part", "supplier", "partsupp",
+             "customer", "orders", "lineitem"]
+    for name in names:
+        if path is not None:
+            import os
+
+            df = spark.read.parquet(os.path.join(path, f"{name}.parquet"))
+        else:
+            df = spark.createDataFrame(tables[name])
+        df.createOrReplaceTempView(name)

@@ -1,0 +1,74 @@
+"""TPC-H end-to-end: all 22 queries, parsed from SQL, executed on both
+engines, results checked against the sqlite3 external oracle.
+
+This is the parity harness SURVEY §4 calls for (reference model:
+TPCHQuerySuite.scala:26 + golden files). Scale sf=0.02 keeps the suite
+fast while producing non-empty results for every query.
+"""
+
+import pytest
+
+from spark_tpu.tpch.gen import generate_tables, register_views
+from spark_tpu.tpch.oracle import assert_rows_match, load_sqlite, run_oracle
+from spark_tpu.tpch.queries import QUERIES
+
+SF = 0.02
+
+
+@pytest.fixture(scope="module")
+def tpch(spark):
+    tables = generate_tables(SF)
+    register_views(spark, tables)
+    conn = load_sqlite(tables)
+    return spark, tables, conn
+
+
+def _rows(df):
+    return [tuple(r.values()) for r in
+            (row.asDict() if hasattr(row, "asDict") else row
+             for row in df.collect())]
+
+
+ALL_QUERIES = sorted(QUERIES)
+
+
+@pytest.mark.parametrize("qnum", ALL_QUERIES)
+def test_query_parity_single_device(tpch, qnum):
+    spark, _, conn = tpch
+    df = spark.sql(QUERIES[qnum])
+    got = [tuple(r.values()) for r in (r.asDict() for r in df.collect())]
+    want = run_oracle(conn, QUERIES[qnum])
+    assert want, f"q{qnum}: oracle returned no rows — bad generator seed?"
+    assert_rows_match(got, want, label=f"q{qnum}")
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 4, 5, 6, 10, 12, 14, 16, 18, 19])
+def test_query_parity_mesh(tpch, qnum):
+    """Distributed runs of the shuffle-heavy subset vs the same oracle."""
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+    from spark_tpu.sql.parser import parse_sql
+
+    spark, _, conn = tpch
+    plan = parse_sql(QUERIES[qnum], spark.catalog)
+    ex = MeshExecutor(make_mesh(8))
+    batch = ex.execute_logical(plan)
+    got = [tuple(d.values()) for d in batch.to_pylist()]
+    want = run_oracle(conn, QUERIES[qnum])
+    assert_rows_match(got, want, label=f"q{qnum}[mesh]")
+
+
+def test_all_queries_parse():
+    """Every query text must at least tokenize+parse (plan shape only;
+    execution parity above)."""
+    from spark_tpu.api.session import SparkSession
+    from spark_tpu.sql.parser import parse_sql
+
+    spark = SparkSession.builder.getOrCreate()
+    # views may or may not be registered here; parse against a fresh
+    # catalog with the generated tables
+    tables = generate_tables(0.001)
+    register_views(spark, tables)
+    for qnum, text in QUERIES.items():
+        plan = parse_sql(text, spark.catalog)
+        assert plan.schema.names, f"q{qnum} produced no schema"
