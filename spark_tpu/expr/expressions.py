@@ -106,6 +106,14 @@ class Expression:
     def __hash__(self):
         return object.__hash__(self)
 
+    def __bool__(self):
+        # __eq__ builds a Cmp node, so truthiness of an Expression is
+        # always a bug (it silently made any two Case-sum aggregates
+        # "equal" in dedup paths). Fail loudly instead.
+        raise TypeError(
+            "Expression has no truth value; use expr_key()/semantic_eq() "
+            "for comparison, is_null()/is_not_null() for null tests")
+
     def alias(self, name: str) -> "Alias":
         return Alias(self, name)
 
@@ -144,6 +152,17 @@ def lit_or_expr(v: Any) -> Expression:
     return v if isinstance(v, Expression) else Literal(v)
 
 
+def _key_part(v):
+    """Key for one field value; recurses into arbitrarily nested tuples so
+    no raw Expression (whose __eq__ is the DSL's Cmp builder) ever lands
+    inside a key — e.g. Case.branches is a tuple of (cond, value) pairs."""
+    if isinstance(v, Expression):
+        return expr_key(v)
+    if isinstance(v, tuple):
+        return tuple(_key_part(x) for x in v)
+    return repr(v)
+
+
 def expr_key(e: Expression):
     """Structural identity key (dataclass __eq__ is hijacked by the SQL
     `==` DSL, so semantic comparison goes through this)."""
@@ -151,13 +170,7 @@ def expr_key(e: Expression):
         return ("lit", e.value, repr(e.dtype))
     parts = [type(e).__name__]
     for f_name, f_val in vars(e).items():
-        if isinstance(f_val, Expression):
-            parts.append(expr_key(f_val))
-        elif isinstance(f_val, tuple):
-            parts.append(tuple(
-                expr_key(x) if isinstance(x, Expression) else x for x in f_val))
-        else:
-            parts.append(repr(f_val))
+        parts.append(_key_part(f_val))
     return tuple(parts)
 
 

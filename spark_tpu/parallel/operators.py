@@ -686,19 +686,16 @@ class JoinApplyExec(P.PhysicalPlan):
         matched_b = (K.seg_count(b_idx, pair_ok, rpipe.capacity) > 0
                      if how in ("right", "full") else None)
 
-        helper = P.JoinExec(_SchemaLeaf(Schema(out_schema.fields[:len(lnames)])),
-                            _SchemaLeaf(Schema(out_schema.fields[len(lnames):])),
-                            how, self.left_keys, self.right_keys)
         mask = pair_ok
         if how in ("left", "full"):
-            cols, mask, order, _ = helper._append_unmatched_left(
-                cols, mask, order, lpipe, matched, out_schema)
+            cols, mask, order, _ = P.append_unmatched_left(
+                cols, mask, order, lpipe, matched)
         if how in ("right", "full"):
             if self.broadcast:
                 raise AssertionError(
                     "right/full outer join must not broadcast the build side")
-            cols, mask, order, _ = helper._append_unmatched_right(
-                cols, mask, order, lpipe, rpipe, matched_b, out_schema)
+            cols, mask, order, _ = P.append_unmatched_right(
+                cols, mask, order, lpipe, rpipe, matched_b)
         return Pipe(cols, mask, order)
 
     def _cross(self, lpipe: Pipe, rpipe: Pipe) -> Pipe:

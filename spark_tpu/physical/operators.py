@@ -940,68 +940,13 @@ class JoinExec(PhysicalPlan):
             return Pipe(lpipe.cols, lpipe.mask & ~matched, lpipe.order).to_batch()
 
         if how in ("left", "full"):
-            out = self._append_unmatched_left(
-                cols, pair_ok, order, lpipe, matched, out_schema)
+            out = append_unmatched_left(cols, pair_ok, order, lpipe, matched)
             cols, pair_ok, order, cap = out
         if how in ("right", "full"):
-            out = self._append_unmatched_right(
-                cols, pair_ok, order, lpipe, rpipe, matched_b, out_schema)
+            out = append_unmatched_right(
+                cols, pair_ok, order, lpipe, rpipe, matched_b)
             cols, pair_ok, order, cap = out
         return Pipe(cols, pair_ok, order).to_batch()
-
-    def _append_unmatched_left(self, cols, pair_ok, order, lpipe, matched,
-                               out_schema):
-        """Append left rows with no (condition-passing) match, right side
-        NULL."""
-        lcap = lpipe.capacity
-        n_l = len(lpipe.order)
-        extra_mask = lpipe.mask & ~matched
-        new_cols: Dict[str, TV] = {}
-        for i, name in enumerate(order):
-            tv = cols[name]
-            if i < n_l:
-                src = lpipe.cols[lpipe.order[i]]
-                data = jnp.concatenate([tv.data, src.data])
-                validity = None
-                if tv.validity is not None or src.validity is not None:
-                    validity = jnp.concatenate([
-                        tv.valid_or_true(tv.data.shape[0]),
-                        src.valid_or_true(lcap)])
-            else:
-                data = jnp.concatenate(
-                    [tv.data, jnp.zeros((lcap,), dtype=tv.data.dtype)])
-                validity = jnp.concatenate([
-                    tv.valid_or_true(tv.data.shape[0]),
-                    jnp.zeros((lcap,), dtype=jnp.bool_)])
-            new_cols[name] = TV(data, validity, tv.dtype, tv.dictionary)
-        mask = jnp.concatenate([pair_ok, extra_mask])
-        return new_cols, mask, order, int(mask.shape[0])
-
-    def _append_unmatched_right(self, cols, pair_ok, order, lpipe, rpipe,
-                                matched_b, out_schema):
-        rcap = rpipe.capacity
-        n_l = len(lpipe.order)
-        extra_mask = rpipe.mask & ~matched_b
-        new_cols: Dict[str, TV] = {}
-        cur_cap = cols[order[0]].data.shape[0]
-        for i, name in enumerate(order):
-            tv = cols[name]
-            if i < n_l:
-                data = jnp.concatenate(
-                    [tv.data, jnp.zeros((rcap,), dtype=tv.data.dtype)])
-                validity = jnp.concatenate([
-                    tv.valid_or_true(cur_cap),
-                    jnp.zeros((rcap,), dtype=jnp.bool_)])
-            else:
-                src = rpipe.cols[rpipe.order[i - n_l]]
-                data = jnp.concatenate([tv.data, src.data])
-                validity = None
-                if tv.validity is not None or src.validity is not None:
-                    validity = jnp.concatenate([
-                        tv.valid_or_true(cur_cap), src.valid_or_true(rcap)])
-            new_cols[name] = TV(data, validity, tv.dtype, tv.dictionary)
-        mask = jnp.concatenate([pair_ok, extra_mask])
-        return new_cols, mask, order, int(mask.shape[0])
 
     def _cross(self, lpipe: Pipe, rpipe: Pipe) -> Batch:
         ln = int(np.asarray(lpipe.mask).sum())
@@ -1054,3 +999,62 @@ class JoinExec(PhysicalPlan):
                 tuple(E.expr_key(k) for k in self.right_keys),
                 None if self.condition is None else E.expr_key(self.condition),
                 self.left.plan_key(), self.right.plan_key())
+
+
+def append_unmatched_left(cols, pair_ok, order, lpipe, matched):
+    """Append left rows with no (condition-passing) match; right side NULL.
+
+    Shared by the single-device JoinExec and the mesh JoinApplyExec
+    (reference contract: joins/ShuffledHashJoinExec.scala:38 fullOuterJoin
+    buildSideOrFullOuterJoin — unmatched stream rows padded with nulls).
+    """
+    lcap = lpipe.capacity
+    n_l = len(lpipe.order)
+    extra_mask = lpipe.mask & ~matched
+    new_cols: Dict[str, TV] = {}
+    for i, name in enumerate(order):
+        tv = cols[name]
+        if i < n_l:
+            src = lpipe.cols[lpipe.order[i]]
+            data = jnp.concatenate([tv.data, src.data])
+            validity = None
+            if tv.validity is not None or src.validity is not None:
+                validity = jnp.concatenate([
+                    tv.valid_or_true(tv.data.shape[0]),
+                    src.valid_or_true(lcap)])
+        else:
+            data = jnp.concatenate(
+                [tv.data, jnp.zeros((lcap,), dtype=tv.data.dtype)])
+            validity = jnp.concatenate([
+                tv.valid_or_true(tv.data.shape[0]),
+                jnp.zeros((lcap,), dtype=jnp.bool_)])
+        new_cols[name] = TV(data, validity, tv.dtype, tv.dictionary)
+    mask = jnp.concatenate([pair_ok, extra_mask])
+    return new_cols, mask, order, int(mask.shape[0])
+
+
+def append_unmatched_right(cols, pair_ok, order, lpipe, rpipe, matched_b):
+    """Append right rows with no (condition-passing) match; left side NULL."""
+    rcap = rpipe.capacity
+    n_l = len(lpipe.order)
+    extra_mask = rpipe.mask & ~matched_b
+    new_cols: Dict[str, TV] = {}
+    cur_cap = cols[order[0]].data.shape[0]
+    for i, name in enumerate(order):
+        tv = cols[name]
+        if i < n_l:
+            data = jnp.concatenate(
+                [tv.data, jnp.zeros((rcap,), dtype=tv.data.dtype)])
+            validity = jnp.concatenate([
+                tv.valid_or_true(cur_cap),
+                jnp.zeros((rcap,), dtype=jnp.bool_)])
+        else:
+            src = rpipe.cols[rpipe.order[i - n_l]]
+            data = jnp.concatenate([tv.data, src.data])
+            validity = None
+            if tv.validity is not None or src.validity is not None:
+                validity = jnp.concatenate([
+                    tv.valid_or_true(cur_cap), src.valid_or_true(rcap)])
+        new_cols[name] = TV(data, validity, tv.dtype, tv.dictionary)
+    mask = jnp.concatenate([pair_ok, extra_mask])
+    return new_cols, mask, order, int(mask.shape[0])
