@@ -76,13 +76,13 @@ class DataFrame:
                 exprs.extend(E.Col(n) for n in self.columns)
             else:
                 exprs.append(_c(c))
-        return self._with(L.Project(tuple(exprs), self._plan))
+        return self._with(L.project_with_windows(tuple(exprs), self._plan))
 
     def selectExpr(self, *exprs: str) -> "DataFrame":
         from spark_tpu.sql.parser import parse_projection
 
         parsed = [parse_projection(s, self._plan.schema) for s in exprs]
-        return self._with(L.Project(tuple(parsed), self._plan))
+        return self._with(L.project_with_windows(tuple(parsed), self._plan))
 
     def filter(self, condition: Union[E.Expression, str]) -> "DataFrame":
         if isinstance(condition, str):
@@ -104,7 +104,7 @@ class DataFrame:
                 exprs.append(E.Col(n))
         if not replaced:
             exprs.append(E.Alias(col, name))
-        return self._with(L.Project(tuple(exprs), self._plan))
+        return self._with(L.project_with_windows(tuple(exprs), self._plan))
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         exprs = tuple(

@@ -39,6 +39,35 @@ def expr(sql_text: str) -> Column:
     return parse_expression(sql_text)
 
 
+# ---- window functions ------------------------------------------------------
+
+
+def row_number() -> Column:
+    return E.RowNumber()
+
+
+def rank() -> Column:
+    return E.Rank(dense=False)
+
+
+def dense_rank() -> Column:
+    return E.Rank(dense=True)
+
+
+def ntile(n: int) -> Column:
+    return E.NTile(n)
+
+
+def lag(c: ColumnOrName, offset: int = 1, default: Any = None) -> Column:
+    d = None if default is None else lit(default)
+    return E.LagLead(_c(c), offset, d, lead=False)
+
+
+def lead(c: ColumnOrName, offset: int = 1, default: Any = None) -> Column:
+    d = None if default is None else lit(default)
+    return E.LagLead(_c(c), offset, d, lead=True)
+
+
 # ---- aggregates ------------------------------------------------------------
 
 

@@ -20,6 +20,43 @@ from spark_tpu.plan import logical as L
 from spark_tpu.types import Field, Schema
 
 
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache. XLA compiles on this class of
+    host are multi-second even for trivial programs; the disk cache turns
+    warm-process startup into sub-second loads (the analogue of the
+    reference reusing Janino-compiled classes across queries,
+    CodeGenerator.scala:1442 'cache')."""
+    import os
+
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
+    # AOT executables embed the compile machine's ISA features; loading
+    # them on a host without those features can SIGILL. Key the cache
+    # dir on a CPU-feature fingerprint as well as the backend.
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((ln for ln in f if ln.startswith("flags")), "")
+        cpu_tag = hashlib.sha1(flags.encode()).hexdigest()[:8]
+    except OSError:
+        import platform as _plat
+
+        cpu_tag = _plat.machine()
+    cache_dir = os.environ.get(
+        "SPARK_TPU_JAX_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     f"spark_tpu_jax_{platform}_{cpu_tag}"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # older jax without these flags: in-memory caching only
+
+
 class Catalog:
     """Temp-view + table registry (reference:
     sql/catalyst/.../catalog/SessionCatalog.scala:61, pared to the
@@ -91,6 +128,7 @@ class SparkSession:
                  conf: Optional[Dict[str, Any]] = None):
         # SQL engines need 64-bit ints/floats; flip jax's default.
         jax.config.update("jax_enable_x64", True)
+        _enable_compilation_cache()
         self.app_name = app_name
         self.conf = RuntimeConf(conf)
         self.catalog = Catalog(self)

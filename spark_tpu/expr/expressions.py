@@ -147,9 +147,29 @@ class Expression:
     def semantic_eq(self, other: "Expression") -> bool:
         return expr_key(self) == expr_key(other)
 
+    def over(self, window) -> "Expression":
+        """Attach a window spec (pyspark Column.over)."""
+        return window._attach(self)
+
 
 def lit_or_expr(v: Any) -> Expression:
     return v if isinstance(v, Expression) else Literal(v)
+
+
+def dedup_pair_names(left_names, right_names) -> list:
+    """Joined-pair output names: left keeps its names, duplicates from
+    the right gain '#2' suffixes. THE canonical copy — logical Join
+    schema, physical pair envs, and optimizer/subquery condition
+    rewrites must all agree on this mapping."""
+    seen = set()
+    out = []
+    for n in list(left_names) + list(right_names):
+        name = n
+        while name in seen:
+            name = name + "#2"
+        seen.add(name)
+        out.append(name)
+    return out
 
 
 def _key_part(v):
@@ -560,6 +580,141 @@ class Abs(Expression):
         return f"ABS({self.child})"
 
 
+# ---- window expressions -----------------------------------------------------
+
+
+@dataclass(eq=False, frozen=True)
+class RowNumber(Expression):
+    """row_number() — 1-based position within the window partition
+    (reference: expressions/windowExpressions.scala RowNumber)."""
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def nullable(self, schema):
+        return False
+
+    @property
+    def name(self):
+        return "row_number()"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Rank(Expression):
+    dense: bool = False
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def nullable(self, schema):
+        return False
+
+    @property
+    def name(self):
+        return "dense_rank()" if self.dense else "rank()"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class LagLead(Expression):
+    """lag/lead(child, offset, default) — value of a row offset rows
+    before/after within the partition (reference:
+    windowExpressions.scala Lag/Lead)."""
+
+    child: Expression
+    offset: int
+    default: Optional[Expression]
+    lead: bool  # False = lag
+
+    def children(self):
+        return (self.child,) if self.default is None \
+            else (self.child, self.default)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        fn = "lead" if self.lead else "lag"
+        return f"{fn}({self.child}, {self.offset})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class NTile(Expression):
+    n: int
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def nullable(self, schema):
+        return False
+
+    @property
+    def name(self):
+        return f"ntile({self.n})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class WindowExpr(Expression):
+    """fn OVER (PARTITION BY ... ORDER BY ... frame) (reference:
+    expressions/windowExpressions.scala WindowExpression +
+    WindowSpecDefinition). ``frame`` is (mode, start, end) with mode
+    'rows'|'range', bounds None=unbounded, 0=current row, +/-n offsets;
+    None frame means the SQL default (RANGE UNBOUNDED PRECEDING..CURRENT
+    ROW with ORDER BY, whole partition without)."""
+
+    func: Expression
+    partition_by: Tuple[Expression, ...]
+    order_by: Tuple["SortOrder", ...]
+    frame: Optional[Tuple[str, Optional[int], Optional[int]]] = None
+
+    def children(self):
+        return (self.func,) + tuple(self.partition_by) + tuple(self.order_by)
+
+    def data_type(self, schema):
+        dt = self.func.data_type(schema)
+        if isinstance(self.func, Count):
+            return T.INT64
+        return dt
+
+    @property
+    def name(self):
+        return f"{self.func.name} OVER (...)"
+
+    def __str__(self):
+        return self.name
+
+
+def window_dictionary(w: "WindowExpr", schema) -> Optional[tuple]:
+    """String dictionary of a window output, when the function carries
+    values through from a dictionary-encoded column (lag/lead/min/max/
+    first)."""
+    fn = w.func
+    if not isinstance(fn, (LagLead, Min, Max, First)):
+        return None
+    c = strip_alias(fn.child)
+    if isinstance(c, Col) and c.col_name in schema:
+        return schema.field(c.col_name).dictionary
+    return None
+
+
+def contains_window(e: Expression) -> bool:
+    if isinstance(e, WindowExpr):
+        return True
+    return any(contains_window(c) for c in e.children())
+
+
 # ---- subquery expressions ---------------------------------------------------
 
 
@@ -833,12 +988,16 @@ def strip_alias(e: Expression) -> Expression:
 def contains_aggregate(e: Expression) -> bool:
     if isinstance(e, AggregateExpression):
         return True
+    if isinstance(e, WindowExpr):
+        return False  # the aggregate belongs to the window, not the query
     return any(contains_aggregate(c) for c in e.children())
 
 
 def collect_aggregates(e: Expression) -> list:
     if isinstance(e, AggregateExpression):
         return [e]
+    if isinstance(e, WindowExpr):
+        return []
     out = []
     for c in e.children():
         out.extend(collect_aggregates(c))
@@ -873,7 +1032,9 @@ def transform_expr(e: Expression, fn) -> Expression:
                         transform_expr(y, fn) if isinstance(y, Expression) else y
                         for y in x
                     )
-                    changed |= ny != x
+                    # identity check: `ny != x` would route through the
+                    # DSL __eq__/__bool__ on Expression elements
+                    changed |= any(a is not b for a, b in zip(ny, x))
                     nlist.append(ny)
                 else:
                     nlist.append(x)

@@ -485,9 +485,11 @@ class DistSortAggExec(P.PhysicalPlan):
         spipe, sorted_keys, seg, ng = P.sorted_groups(pipe, key_tvs)
         env2 = spipe.env()
         _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
-        agg_tvs = [P._compute_agg(a, env2, seg, spipe.mask, cap, cap)
+        agg_tvs = [P._compute_agg(a, env2, seg, spipe.mask, cap, cap,
+                                  sorted_seg=True)
                    for a in agg_calls]
-        out_keys = P.first_group_keys(sorted_keys, seg, spipe.mask, cap, cap)
+        out_keys = P.first_group_keys(sorted_keys, seg, spipe.mask, cap, cap,
+                                      sorted_seg=True)
         out_mask = jnp.arange(cap) < ng
         agg_exec = P.HashAggregateExec(self.groupings, self.aggregates,
                                        self.child)

@@ -93,6 +93,27 @@ class PhysicalPlan:
         pipes = [Pipe.from_batch_data(b.schema, b.data) for b in child_batches]
         return self.trace(pipes).to_batch()
 
+    def stats_key(self) -> tuple:
+        """Identity for adaptive runtime stats: plan structure + leaf
+        array ids (jax arrays are immutable, so id-equality implies
+        data-equality — stats recorded for these exact arrays can be
+        replayed as static trace constants). Returns (key, arrays): the
+        cache weakrefs ``arrays`` and self-evicts when any dies, so a
+        recycled id can never alias a live entry."""
+        scans: List["BatchScanExec"] = []
+
+        def collect(p: PhysicalPlan) -> None:
+            if isinstance(p, BatchScanExec):
+                scans.append(p)
+                return
+            for c in p.children():
+                collect(c)
+
+        collect(self)
+        pins = tuple(cd.data for s in scans for cd in s.batch.data.columns)
+        ids = tuple(id(a) for a in pins)
+        return ((self.plan_key(), ids), pins)
+
     def tree_string(self, indent: int = 0) -> str:
         line = "  " * indent + self.node_string()
         return "\n".join([line] + [c.tree_string(indent + 1)
@@ -519,16 +540,17 @@ def sorted_groups(pipe: Pipe, key_tvs: List[TV]):
 
 
 def first_group_keys(sorted_keys: List[TV], seg, mask, num_segments: int,
-                     capacity: int) -> List[TV]:
+                     capacity: int, sorted_seg: bool = False) -> List[TV]:
     """Representative (first-row) key values per group."""
     out = []
     for tv in sorted_keys:
-        data, found = K.seg_first(tv.data, seg, mask, num_segments, capacity)
+        data, found = K.seg_first(tv.data, seg, mask, num_segments, capacity,
+                                  sorted_seg)
         if tv.validity is None:
             valid = None
         else:
             vdata, _ = K.seg_first(tv.validity, seg, mask, num_segments,
-                                   capacity)
+                                   capacity, sorted_seg)
             valid = vdata & found
         out.append(TV(data, valid, tv.dtype, tv.dictionary))
     return out
@@ -549,47 +571,51 @@ def _distinct_mask_cached(env: Env, child: E.Expression, tv: TV, seg,
 
 
 def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
-                 num_segments: int, capacity: int) -> TV:
+                 num_segments: int, capacity: int,
+                 sorted_seg: bool = False) -> TV:
     """Compute one aggregate over segments. Nulls in the input are
     excluded per SQL semantics; a group with no valid input yields NULL
-    (except count)."""
+    (except count). ``sorted_seg`` marks monotone segment ids (the
+    sort-agg path) unlocking the cumsum-based kernels — scatter-add is
+    pathologically slow on TPU (see kernels.py)."""
     if isinstance(agg, E.Count) and agg.child is None:
-        cnt = K.seg_count(seg, mask, num_segments)
+        cnt = K.seg_count(seg, mask, num_segments, sorted_seg)
         return TV(cnt, None, T.INT64, None)
 
     child = agg.child  # type: ignore[attr-defined]
     tv = C.evaluate(child, env)
     ok = mask & tv.valid_or_true(capacity)
-    any_valid = K.seg_count(seg, ok, num_segments) > 0
+    any_valid = K.seg_count(seg, ok, num_segments, sorted_seg) > 0
     if getattr(agg, "distinct", False):
         # DISTINCT: keep one ok row per (group, value); any_valid is
         # computed before dedup (unchanged by it anyway).
         ok = ok & _distinct_mask_cached(env, agg.child, tv, seg, ok)
 
     if isinstance(agg, E.Count):
-        cnt = K.seg_count(seg, ok, num_segments)
+        cnt = K.seg_count(seg, ok, num_segments, sorted_seg)
         return TV(cnt, None, T.INT64, None)
     if isinstance(agg, E.Sum):
         out_dt = T.INT64 if tv.dtype.is_integral else tv.dtype
         data = tv.data.astype(C._jnp_dtype(out_dt))
-        s = K.seg_sum(data, seg, ok, num_segments)
+        s = K.seg_sum(data, seg, ok, num_segments, sorted_seg)
         return TV(s, any_valid, out_dt, None)
     if isinstance(agg, E.Avg):
-        s = K.seg_sum(tv.data.astype(jnp.float64), seg, ok, num_segments)
-        c = K.seg_count(seg, ok, num_segments)
+        s = K.seg_sum(tv.data.astype(jnp.float64), seg, ok, num_segments,
+                      sorted_seg)
+        c = K.seg_count(seg, ok, num_segments, sorted_seg)
         data = s / jnp.maximum(c, 1)
         return TV(data, any_valid, T.FLOAT64, None)
     if isinstance(agg, E.Min):
-        m = K.seg_min(tv.data, seg, ok, num_segments)
+        m = K.seg_min(tv.data, seg, ok, num_segments, sorted_seg)
         return TV(m, any_valid, tv.dtype, tv.dictionary)
     if isinstance(agg, E.Max):
-        m = K.seg_max(tv.data, seg, ok, num_segments)
+        m = K.seg_max(tv.data, seg, ok, num_segments, sorted_seg)
         return TV(m, any_valid, tv.dtype, tv.dictionary)
     if isinstance(agg, E.StddevVariance):
         x = tv.data.astype(jnp.float64)
-        c = K.seg_count(seg, ok, num_segments).astype(jnp.float64)
-        s = K.seg_sum(x, seg, ok, num_segments)
-        s2 = K.seg_sum(x * x, seg, ok, num_segments)
+        c = K.seg_count(seg, ok, num_segments, sorted_seg).astype(jnp.float64)
+        s = K.seg_sum(x, seg, ok, num_segments, sorted_seg)
+        s2 = K.seg_sum(x * x, seg, ok, num_segments, sorted_seg)
         m2 = s2 - (s * s) / jnp.maximum(c, 1.0)
         m2 = jnp.maximum(m2, 0.0)
         kind = agg.kind
@@ -600,10 +626,11 @@ def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
         return TV(data, any_valid & enough, T.FLOAT64, None)
     if isinstance(agg, E.First):
         use = ok if agg.ignore_nulls else mask
-        data, found = K.seg_first(tv.data, seg, use, num_segments, capacity)
+        data, found = K.seg_first(tv.data, seg, use, num_segments, capacity,
+                                  sorted_seg)
         valid = found if tv.validity is None else (
             found & K.seg_first(tv.valid_or_true(capacity), seg, use,
-                                num_segments, capacity)[0])
+                                num_segments, capacity, sorted_seg)[0])
         return TV(data, valid, tv.dtype, tv.dictionary)
     raise NotImplementedError(f"aggregate {agg!r}")
 
@@ -626,13 +653,16 @@ class HashAggregateExec(PhysicalPlan):
     groupings: Tuple[E.Expression, ...]
     aggregates: Tuple[E.Expression, ...]
     child: PhysicalPlan
+    #: bound by the planner from _AGG_STATS: observed group count, which
+    #: makes the sort-based path traceable with a static output capacity
+    adaptive: Optional[int] = None
 
     def children(self):
         return (self.child,)
 
     @property
     def traceable(self) -> bool:  # type: ignore[override]
-        return self._static_direct_ok()
+        return self._static_direct_ok() or self.adaptive is not None
 
     def _static_direct_ok(self) -> bool:
         """Can we guarantee the direct path from schema info alone?"""
@@ -691,6 +721,8 @@ class HashAggregateExec(PhysicalPlan):
 
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
         pipe = child_pipes[0]
+        if not self._static_direct_ok():
+            return self._trace_sorted(pipe)
         env = pipe.env()
         cap = pipe.capacity
         key_tvs = [C.evaluate(g, env) for g in self.groupings]
@@ -723,6 +755,25 @@ class HashAggregateExec(PhysicalPlan):
 
     # -- sort-based path ------------------------------------------------------
 
+    def _trace_sorted(self, pipe: Pipe) -> Pipe:
+        """Sort-based aggregation with STATIC output capacity from
+        adaptive stats (the group count observed on the first, blocking
+        execution of these exact leaf arrays) — no host sync, fusable."""
+        env = pipe.env()
+        cap = pipe.capacity
+        key_tvs = [C.evaluate(g, env) for g in self.groupings]
+        pipe2, sorted_keys, seg, ng = sorted_groups(pipe, key_tvs)
+        num_segments = K.bucket(max(1, self.adaptive), 256)
+        env2 = pipe2.env()
+        _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
+        agg_tvs = [_compute_agg(a, env2, seg, pipe2.mask, num_segments, cap,
+                                sorted_seg=True)
+                   for a in agg_calls]
+        out_keys = first_group_keys(sorted_keys, seg, pipe2.mask,
+                                    num_segments, cap, sorted_seg=True)
+        out_mask = jnp.arange(num_segments) < ng  # ng stays on device
+        return self._finalize(out_keys, agg_tvs, out_mask, num_segments)
+
     def execute_blocking(self, child_batches: List[Batch]) -> Batch:
         pipe = Pipe.from_batch_data(child_batches[0].schema,
                                     child_batches[0].data)
@@ -739,14 +790,17 @@ class HashAggregateExec(PhysicalPlan):
         else:
             pipe2, sorted_keys, seg, ng = sorted_groups(pipe, key_tvs)
             n_groups = max(1, int(ng))  # host sync: output sizing
+            _AGG_STATS.put(self.stats_key(), n_groups)
 
         num_segments = K.bucket(n_groups, 256)
         env2 = pipe2.env()
         _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
-        agg_tvs = [_compute_agg(a, env2, seg, pipe2.mask, num_segments, cap)
+        sorted_seg = bool(key_tvs)
+        agg_tvs = [_compute_agg(a, env2, seg, pipe2.mask, num_segments, cap,
+                                sorted_seg=sorted_seg)
                    for a in agg_calls]
         out_keys = first_group_keys(sorted_keys, seg, pipe2.mask,
-                                    num_segments, cap)
+                                    num_segments, cap, sorted_seg=sorted_seg)
         out_mask = jnp.arange(num_segments) < n_groups
         return self._finalize(out_keys, agg_tvs, out_mask,
                               num_segments).to_batch()
@@ -766,17 +820,79 @@ class HashAggregateExec(PhysicalPlan):
 
 
 def _pair_names(left_names, right_names) -> List[str]:
-    """Joined-pair column names: left keeps its names, right duplicates
-    get '#2' suffixes (must match Join.schema dedup)."""
-    seen = set()
-    out = []
-    for n in list(left_names) + list(right_names):
-        name = n
-        while name in seen:
-            name = name + "#2"
-        seen.add(name)
-        out.append(name)
-    return out
+    """Joined-pair column names (delegates to the canonical dedup)."""
+    return E.dedup_pair_names(left_names, right_names)
+
+
+#: Adaptive join statistics (the AQE analogue, reference:
+#: adaptive/AdaptiveSparkPlanExec.scala:247): first execution of a join
+#: runs the blocking path and records key-packing ranges + whether the
+#: build side matched each probe row at most once. Keyed on plan
+#: structure AND the identity of the leaf device arrays — jax arrays are
+#: immutable, so identical ids imply identical data, making the cached
+#: stats sound. With stats present, PK-FK joins become fully traceable
+#: (output capacity = probe capacity) and fuse into one XLA program with
+#: zero host syncs — the difference between ~6 and ~2 tunnel round trips
+#: per TPC-H query.
+class _AdaptiveStatsCache:
+    """Bounded stats cache whose keys embed id() of leaf device arrays.
+
+    An id can be recycled after its array is garbage-collected, which
+    would silently replay stale stats (wrong clip ranges -> wrong join
+    results). Entries therefore hold WEAKREFS to the arrays and are
+    evicted the moment any referenced array dies — no HBM is pinned, and
+    a recycled id can never alias a live entry. LRU-bounded as well."""
+
+    def __init__(self, maxsize: int = 256):
+        from collections import OrderedDict
+
+        self._data: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._maxsize = maxsize
+
+    def _alive(self, key) -> bool:
+        v = self._data.get(key)
+        if v is None:
+            return False
+        _, refs = v
+        if any(r() is None for r in refs):
+            del self._data[key]
+            return False
+        return True
+
+    def get(self, key_and_pins):
+        key, _ = key_and_pins
+        if not self._alive(key):
+            return None
+        self._data.move_to_end(key)
+        return self._data[key][0]
+
+    def put(self, key_and_pins, value) -> None:
+        import weakref
+
+        key, pins = key_and_pins
+        try:
+            refs = tuple(weakref.ref(a) for a in pins)
+        except TypeError:
+            return  # non-weakref-able leaf: safer to skip caching
+        self._data[key] = (value, refs)
+        self._data.move_to_end(key)
+        while len(self._data) > self._maxsize:
+            self._data.popitem(last=False)
+
+    def __contains__(self, key_and_pins) -> bool:
+        return self._alive(key_and_pins[0])
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+_JOIN_STATS = _AdaptiveStatsCache()
+
+#: Adaptive aggregation statistics: observed group count per
+#: (plan, leaf-array-ids) — lets the sort-based aggregation path trace
+#: with a static output capacity on re-execution (same AQE idea as
+#: _JOIN_STATS; reference: AdaptiveSparkPlanExec.scala:247).
+_AGG_STATS = _AdaptiveStatsCache()
 
 
 @dataclass(eq=False)
@@ -784,8 +900,9 @@ class JoinExec(PhysicalPlan):
     """Equi-join via sorted-build + searchsorted ranges (reference:
     ShuffledHashJoinExec.scala:38 / BroadcastHashJoinExec.scala:40 +
     HashedRelation.scala — rebuilt without hash tables, see
-    kernels.build_join_ranges). Blocking: output capacity is the
-    host-synced match count, bucketed."""
+    kernels.build_join_ranges). Blocking on first execution (output
+    capacity is the host-synced match count); unique-build inner/left/
+    semi/anti joins become traceable once _JOIN_STATS has their packing."""
 
     left: PhysicalPlan
     right: PhysicalPlan
@@ -793,7 +910,20 @@ class JoinExec(PhysicalPlan):
     left_keys: Tuple[E.Expression, ...]
     right_keys: Tuple[E.Expression, ...]
     condition: Optional[E.Expression] = None
-    traceable = False
+    #: bound by the planner from _JOIN_STATS: tuple of per-key (mn, rg)
+    adaptive: Optional[tuple] = None
+
+    @property
+    def traceable(self) -> bool:
+        if self.adaptive is None:
+            return False
+        _, unique_build, unique_probe = self.adaptive
+        if unique_build and self.how in ("inner", "left", "left_semi",
+                                         "left_anti"):
+            return True
+        # sides of an INNER join are symmetric: a unique probe side can
+        # play the build role (output capacity = right capacity)
+        return unique_probe and self.how == "inner"
 
     def children(self):
         return (self.left, self.right)
@@ -808,14 +938,10 @@ class JoinExec(PhysicalPlan):
             rf = [dataclasses.replace(f, nullable=True) for f in rf]
         if self.how in ("right", "full"):
             lf = [dataclasses.replace(f, nullable=True) for f in lf]
-        seen = set()
-        out = []
-        for f in lf + rf:
-            name = f.name
-            while name in seen:
-                name = name + "#2"
-            seen.add(name)
-            out.append(dataclasses.replace(f, name=name))
+        names = E.dedup_pair_names([f.name for f in lf],
+                                   [f.name for f in rf])
+        out = [dataclasses.replace(f, name=n)
+               for f, n in zip(lf + rf, names)]
         return Schema(tuple(out))
 
     # -- key normalization ----------------------------------------------------
@@ -832,15 +958,20 @@ class JoinExec(PhysicalPlan):
         rcomb = jnp.zeros((rpipe.capacity,), dtype=jnp.int64)
         lvalid = jnp.ones((lpipe.capacity,), dtype=jnp.bool_)
         rvalid = jnp.ones((rpipe.capacity,), dtype=jnp.bool_)
-        total_range = 1
+
+        # phase 1: per-key data + deferred min/max stats, fetched with ONE
+        # host sync for ALL int keys (each int(...) is a full blocking
+        # round trip — 87 ms on a tunneled TPU, and multi-key joins paid
+        # it twice per key)
+        prepped = []  # (ld, rd, rg_or_None, stat_index_or_None)
+        stats = []
         for lt, rt in zip(lks, rks):
             if isinstance(lt.dtype, T.StringType) or isinstance(rt.dtype, T.StringType):
                 union, (tl, tr) = C.unify_dictionaries(
                     (lt.dictionary or (), rt.dictionary or ()))
                 ld = jnp.asarray(tl)[lt.data] if len(lt.dictionary or ()) else lt.data
                 rd = jnp.asarray(tr)[rt.data] if len(rt.dictionary or ()) else rt.data
-                rg = max(1, len(union))
-                mn = 0
+                prepped.append((ld, rd, max(1, len(union)), None))
             else:
                 ld = lt.data.astype(jnp.int64)
                 rd = rt.data.astype(jnp.int64)
@@ -854,8 +985,16 @@ class JoinExec(PhysicalPlan):
                 r_hi = jnp.where(rpipe.mask & rt.valid_or_true(rpipe.capacity),
                                  rd, jnp.iinfo(jnp.int64).min)
                 hi = jnp.maximum(jnp.max(l_hi), jnp.max(r_hi))
-                mn = int(lo)  # host sync: key stats
-                mx = int(hi)
+                prepped.append((ld, rd, None, len(stats)))
+                stats.append((lo, hi))
+        fetched = jax.device_get(stats) if stats else []
+
+        total_range = 1
+        packing: List[Tuple[int, int]] = []
+        for ld, rd, rg, si in prepped:
+            mn = 0
+            if rg is None:
+                mn, mx = int(fetched[si][0]), int(fetched[si][1])
                 if mn > mx:
                     mn, mx = 0, 0
                 rg = mx - mn + 1
@@ -865,11 +1004,130 @@ class JoinExec(PhysicalPlan):
             lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
             rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
             total_range *= rg
+            packing.append((mn, rg))
+        for lt, rt in zip(lks, rks):
+            if lt.validity is not None:
+                lvalid = lvalid & lt.validity
+            if rt.validity is not None:
+                rvalid = rvalid & rt.validity
+        return lcomb, lvalid, rcomb, rvalid, tuple(packing)
+
+    # -- traced path (adaptive, unique-build) ---------------------------------
+
+    def _traced_keys(self, lpipe: Pipe, rpipe: Pipe):
+        """Key packing with STATIC per-key (mn, rg) from adaptive stats —
+        no host syncs, so the join fuses into the surrounding program.
+        Sound because the planner only binds stats recorded for these
+        exact (immutable) leaf arrays."""
+        lenv, renv = lpipe.env(), rpipe.env()
+        lks = [C.evaluate(k, lenv) for k in self.left_keys]
+        rks = [C.evaluate(k, renv) for k in self.right_keys]
+        lcomb = jnp.zeros((lpipe.capacity,), dtype=jnp.int64)
+        rcomb = jnp.zeros((rpipe.capacity,), dtype=jnp.int64)
+        lvalid = jnp.ones((lpipe.capacity,), dtype=jnp.bool_)
+        rvalid = jnp.ones((rpipe.capacity,), dtype=jnp.bool_)
+        for (lt, rt), (mn, rg) in zip(zip(lks, rks), self.adaptive[0]):
+            if isinstance(lt.dtype, T.StringType) \
+                    or isinstance(rt.dtype, T.StringType):
+                union, (tl, tr) = C.unify_dictionaries(
+                    (lt.dictionary or (), rt.dictionary or ()))
+                ld = (jnp.asarray(tl)[lt.data]
+                      if len(lt.dictionary or ()) else lt.data)
+                rd = (jnp.asarray(tr)[rt.data]
+                      if len(rt.dictionary or ()) else rt.data)
+                mn, rg = 0, max(rg, len(union))
+            else:
+                ld = lt.data.astype(jnp.int64)
+                rd = rt.data.astype(jnp.int64)
+            lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
+            rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
             if lt.validity is not None:
                 lvalid = lvalid & lt.validity
             if rt.validity is not None:
                 rvalid = rvalid & rt.validity
         return lcomb, lvalid, rcomb, rvalid
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        """Unique-build join as a pure gather: each probe row has at most
+        one match (adaptive stats proved it), so output capacity equals
+        probe capacity and no sizing sync is needed. This is the PK-FK
+        fast path every TPC-H join takes after the first execution."""
+        lpipe, rpipe = child_pipes
+        _, unique_build, unique_probe = self.adaptive
+        lcomb, lvalid, rcomb, rvalid = self._traced_keys(lpipe, rpipe)
+        if not unique_build:
+            # inner join with unique LEFT side: swap roles — left becomes
+            # the build, output rows ride at right capacity
+            return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
+                                       rcomb, rvalid)
+        ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
+                                     lcomb, lpipe.mask & lvalid)
+        has = ranges.counts > 0
+        b_idx = ranges.build_perm[
+            jnp.clip(ranges.lo, 0, rpipe.capacity - 1)]
+        if self.how in ("left_semi", "left_anti") and self.condition is None:
+            keep = lpipe.mask & (has if self.how == "left_semi" else ~has)
+            return Pipe(lpipe.cols, keep, lpipe.order)
+        pair_names = _pair_names(lpipe.order, rpipe.order)
+        n_l = len(lpipe.order)
+        cols: Dict[str, TV] = {}
+        order: List[str] = []
+        for out_name, src in zip(pair_names[:n_l], lpipe.order):
+            cols[out_name] = lpipe.cols[src]
+            order.append(out_name)
+        for out_name, src in zip(pair_names[n_l:], rpipe.order):
+            tv = rpipe.cols[src]
+            validity = tv.valid_or_true(rpipe.capacity)[b_idx] & has
+            cols[out_name] = TV(tv.data[b_idx], validity, tv.dtype,
+                                tv.dictionary)
+            order.append(out_name)
+        pair_ok = lpipe.mask & has
+        if self.condition is not None:
+            env = Env(cols, lpipe.capacity)
+            ctv = C.evaluate(self.condition, env)
+            pair_ok = pair_ok & ctv.data & ctv.valid_or_true(lpipe.capacity)
+        if self.how == "left_semi":
+            return Pipe(lpipe.cols, pair_ok, lpipe.order)
+        if self.how == "left_anti":
+            return Pipe(lpipe.cols, lpipe.mask & ~pair_ok, lpipe.order)
+        if self.how == "inner":
+            return Pipe(cols, pair_ok, order)
+        # left outer: keep every live left row, NULL right side where the
+        # (condition-passing) match is absent
+        for out_name in pair_names[n_l:]:
+            tv = cols[out_name]
+            validity = tv.valid_or_true(lpipe.capacity) & pair_ok
+            cols[out_name] = TV(tv.data, validity, tv.dtype, tv.dictionary)
+        return Pipe(cols, lpipe.mask, order)
+
+    def _trace_swapped(self, lpipe: Pipe, rpipe: Pipe, lcomb, lvalid,
+                       rcomb, rvalid) -> Pipe:
+        """Inner join with a unique LEFT side: build on the left, stream
+        the right; each right row gathers its single left match."""
+        ranges = K.build_join_ranges(lcomb, lpipe.mask & lvalid,
+                                     rcomb, rpipe.mask & rvalid)
+        has = ranges.counts > 0
+        l_idx = ranges.build_perm[
+            jnp.clip(ranges.lo, 0, lpipe.capacity - 1)]
+        pair_names = _pair_names(lpipe.order, rpipe.order)
+        n_l = len(lpipe.order)
+        cols: Dict[str, TV] = {}
+        order: List[str] = []
+        for out_name, src in zip(pair_names[:n_l], lpipe.order):
+            tv = lpipe.cols[src]
+            validity = tv.valid_or_true(lpipe.capacity)[l_idx] & has
+            cols[out_name] = TV(tv.data[l_idx], validity, tv.dtype,
+                                tv.dictionary)
+            order.append(out_name)
+        for out_name, src in zip(pair_names[n_l:], rpipe.order):
+            cols[out_name] = rpipe.cols[src]
+            order.append(out_name)
+        pair_ok = rpipe.mask & has
+        if self.condition is not None:
+            env = Env(cols, rpipe.capacity)
+            ctv = C.evaluate(self.condition, env)
+            pair_ok = pair_ok & ctv.data & ctv.valid_or_true(rpipe.capacity)
+        return Pipe(cols, pair_ok, order)
 
     def execute_blocking(self, child_batches: List[Batch]) -> Batch:
         lpipe = Pipe.from_batch_data(child_batches[0].schema,
@@ -878,22 +1136,50 @@ class JoinExec(PhysicalPlan):
                                      child_batches[1].data)
         how = self.how
 
-        if how == "cross":
+        if how == "cross" and self.condition is None:
             return self._cross(lpipe, rpipe)
+        if not self.left_keys:
+            # condition-only join: chunked nested loop instead of
+            # materializing all L*R pairs at once (reference:
+            # BroadcastNestedLoopJoinExec; VERDICT r2 weak #4 — q19-class
+            # plans used to OOM/hang here)
+            return self._nested_loop(lpipe, rpipe, how)
 
-        lkey, lvalid, rkey, rvalid = self._combined_keys(lpipe, rpipe)
+        lkey, lvalid, rkey, rvalid, packing = self._combined_keys(
+            lpipe, rpipe)
         # probe = left, build = right (left-side row order is preserved,
         # matching streamed-side semantics)
         ranges = K.build_join_ranges(rkey, rpipe.mask & rvalid,
                                      lkey, lpipe.mask & lvalid)
 
+        adaptive_how = how in ("inner", "left", "left_semi", "left_anti")
+        sk = self.stats_key() if adaptive_how else None
+        record = adaptive_how and sk not in _JOIN_STATS
+
         if how in ("left_semi", "left_anti") and self.condition is None:
+            if record:
+                maxc = int(jax.device_get(ranges.counts.max()))
+                _JOIN_STATS.put(sk, (packing, maxc <= 1, False))
             has_match = ranges.counts > 0
             keep = lpipe.mask & (has_match if how == "left_semi"
                                  else ~has_match)
             return Pipe(lpipe.cols, keep, lpipe.order).to_batch()
 
-        total = int(ranges.counts.sum())  # host sync: output sizing
+        # host sync: output sizing (+ on the FIRST run, max matches per
+        # probe row AND per build row — either direction being unique
+        # makes this join traceable next execution, swapped roles for a
+        # unique probe; skipped entirely once stats are recorded)
+        if record:
+            rev = K.build_join_ranges(lkey, lpipe.mask & lvalid,
+                                      rkey, rpipe.mask & rvalid)
+            total, maxc, maxb = (int(v) for v in jax.device_get(
+                (ranges.counts.sum(), ranges.counts.max(),
+                 rev.counts.max())))
+            # negative results cached too (traceable stays False for
+            # them) so re-executions skip the reverse-ranges probe
+            _JOIN_STATS.put(sk, (packing, maxc <= 1, maxb <= 1))
+        else:
+            total = int(ranges.counts.sum())  # host sync: output sizing
         cap = K.bucket(total)
         p_idx, b_idx, pair_mask = K.expand_join_pairs(ranges, cap)
 
@@ -945,6 +1231,101 @@ class JoinExec(PhysicalPlan):
         if how in ("right", "full"):
             out = append_unmatched_right(
                 cols, pair_ok, order, lpipe, rpipe, matched_b)
+            cols, pair_ok, order, cap = out
+        return Pipe(cols, pair_ok, order).to_batch()
+
+    def _nested_loop(self, lpipe: Pipe, rpipe: Pipe, how: str) -> Batch:
+        """Condition-only join evaluated in fixed-size left-chunks of
+        bounded pair count. Fixed chunk shapes mean one XLA dispatch
+        compile serves every chunk; surviving pair indices are pulled to
+        host per chunk (this is the blocking path) and gathered once at
+        the end."""
+        lcap = lpipe.capacity
+        rcap = rpipe.capacity
+        rn = int(np.asarray(rpipe.mask).sum())  # host sync: build size
+        rperm = K.compaction_permutation(rpipe.mask)
+        pair_names = _pair_names(lpipe.order, rpipe.order)
+        lnames = list(lpipe.order)
+
+        def gather_pairs(p_idx, b_idx) -> Tuple[Dict[str, TV], List[str]]:
+            cols: Dict[str, TV] = {}
+            order: List[str] = []
+            for out_name, src_name in zip(pair_names[:len(lnames)], lnames):
+                tv = lpipe.cols[src_name]
+                cols[out_name] = TV(
+                    tv.data[p_idx],
+                    None if tv.validity is None else tv.validity[p_idx],
+                    tv.dtype, tv.dictionary)
+                order.append(out_name)
+            for out_name, src_name in zip(pair_names[len(lnames):],
+                                          rpipe.order):
+                tv = rpipe.cols[src_name]
+                cols[out_name] = TV(
+                    tv.data[b_idx],
+                    None if tv.validity is None else tv.validity[b_idx],
+                    tv.dtype, tv.dictionary)
+                order.append(out_name)
+            return cols, order
+
+        matched_l = np.zeros(lcap, dtype=bool)
+        matched_r = np.zeros(rcap, dtype=bool)
+        keep_p: List[np.ndarray] = []
+        keep_b: List[np.ndarray] = []
+        if rn > 0:
+            budget = 1 << 22  # pairs per chunk (~32 MB of int64 per col)
+            chunk = max(1, min(lcap, budget // rn))
+            j = jnp.arange(chunk * rn)
+            local_p = j // rn
+            b_idx = rperm[j % rn]
+            for start in range(0, lcap, chunk):
+                p_idx = jnp.clip(local_p + start, 0, lcap - 1)
+                pair_ok = (local_p + start < lcap) & lpipe.mask[p_idx]
+                if self.condition is not None:
+                    cols, _ = gather_pairs(p_idx, b_idx)
+                    env = Env(cols, chunk * rn)
+                    ctv = C.evaluate(self.condition, env)
+                    pair_ok = pair_ok & ctv.data & ctv.valid_or_true(
+                        chunk * rn)
+                ok = np.asarray(pair_ok)
+                idx = np.nonzero(ok)[0]
+                if idx.size:
+                    ps = np.asarray(p_idx)[idx]
+                    bs = np.asarray(b_idx)[idx]
+                    matched_l[ps] = True
+                    matched_r[bs] = True
+                    if how not in ("left_semi", "left_anti"):
+                        keep_p.append(ps)
+                        keep_b.append(bs)
+
+        ml = jnp.asarray(matched_l)
+        if how == "left_semi":
+            return Pipe(lpipe.cols, lpipe.mask & ml, lpipe.order).to_batch()
+        if how == "left_anti":
+            return Pipe(lpipe.cols, lpipe.mask & ~ml, lpipe.order).to_batch()
+
+        all_p = (np.concatenate(keep_p) if keep_p
+                 else np.zeros((0,), dtype=np.int64))
+        all_b = (np.concatenate(keep_b) if keep_b
+                 else np.zeros((0,), dtype=np.int64))
+        total = int(all_p.shape[0])
+        cap = K.bucket(total)
+        pad_p = np.zeros(cap, dtype=np.int64)
+        pad_b = np.zeros(cap, dtype=np.int64)
+        pad_p[:total] = all_p
+        pad_b[:total] = all_b
+        p_idx = jnp.asarray(pad_p)
+        b_idx = jnp.asarray(pad_b)
+        pair_ok = jnp.arange(cap) < total
+        cols, order = gather_pairs(p_idx, b_idx)
+
+        if how in ("inner", "cross"):
+            return Pipe(cols, pair_ok, order).to_batch()
+        if how in ("left", "full"):
+            out = append_unmatched_left(cols, pair_ok, order, lpipe, ml)
+            cols, pair_ok, order, cap = out
+        if how in ("right", "full"):
+            out = append_unmatched_right(
+                cols, pair_ok, order, lpipe, rpipe, jnp.asarray(matched_r))
             cols, pair_ok, order, cap = out
         return Pipe(cols, pair_ok, order).to_batch()
 

@@ -61,6 +61,10 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
     if isinstance(plan, L.Sample):
         return P.SampleExec(plan.fraction, plan.seed,
                             plan_physical(plan.child),)
+    if isinstance(plan, L.Window):
+        from spark_tpu.physical.window import WindowExec
+
+        return WindowExec(plan.window_exprs, plan_physical(plan.child))
     if isinstance(plan, L.Join):
         return P.JoinExec(plan_physical(plan.left), plan_physical(plan.right),
                           plan.how, plan.left_keys, plan.right_keys,
@@ -113,6 +117,37 @@ def _strip_leaf_data(plan: P.PhysicalPlan) -> P.PhysicalPlan:
     return dataclasses.replace(plan, **fields)
 
 
+def _bind_adaptive(plan: P.PhysicalPlan) -> None:
+    """Attach recorded runtime stats to join nodes (the re-optimization
+    step of AQE, reference: AdaptiveSparkPlanExec.getFinalPhysicalPlan:247
+    — here 'between executions' instead of 'between stages'). A join
+    whose previous run on these exact leaf arrays proved a unique build
+    side becomes traceable and fuses."""
+    for c in plan.children():
+        _bind_adaptive(c)
+    if isinstance(plan, P.JoinExec) and plan.how in (
+            "inner", "left", "left_semi", "left_anti") and plan.left_keys:
+        plan.adaptive = P._JOIN_STATS.get(plan.stats_key())
+    elif isinstance(plan, P.HashAggregateExec) and plan.groupings \
+            and not plan._static_direct_ok():
+        plan.adaptive = P._AGG_STATS.get(plan.stats_key())
+
+
+def _adaptive_snapshot(plan: P.PhysicalPlan) -> tuple:
+    """Adaptive state of every join in tree order — part of the fused
+    stage cache key (plan_key alone is stable across stats changes)."""
+    out = []
+
+    def go(p: P.PhysicalPlan) -> None:
+        if isinstance(p, (P.JoinExec, P.HashAggregateExec)):
+            out.append(p.adaptive)
+        for c in p.children():
+            go(c)
+
+    go(plan)
+    return tuple(out)
+
+
 def _run_fused(plan: P.PhysicalPlan) -> Batch:
     """Compile a maximal traceable subtree to one XLA program and run it.
     The jit cache is keyed on plan structure + leaf shapes/dictionaries
@@ -121,7 +156,7 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
     leaf-stripped plan skeleton — leaf batch data arrives as arguments."""
     scans: List[P.BatchScanExec] = []
     _collect_scans(plan, scans)
-    key = plan.plan_key()
+    key = (plan.plan_key(), _adaptive_snapshot(plan))
     entry = _STAGE_CACHE.get(key)
     if entry is None:
         schema_box: dict = {}
@@ -171,13 +206,18 @@ def _maybe_compact(batch: Batch) -> Batch:
 
 def execute(plan: P.PhysicalPlan) -> Batch:
     """Run a physical plan: fuse what we can, block where we must."""
+    _bind_adaptive(plan)
+    return _execute(plan)
+
+
+def _execute(plan: P.PhysicalPlan) -> Batch:
     if isinstance(plan, P.BatchScanExec):
         return plan.batch
     if _fully_traceable(plan):
         return _run_fused(plan)
     child_batches = []
     for c in plan.children():
-        b = execute(c)
+        b = _execute(c)
         child_batches.append(_maybe_compact(b))
     return plan.execute_blocking(child_batches)
 

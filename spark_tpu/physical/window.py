@@ -1,0 +1,303 @@
+"""Window function execution (reference:
+sql/core/.../execution/window/WindowExec.scala:87 and
+WindowFunctionFrame.scala).
+
+The reference streams each partition through per-frame processors row by
+row. On a TPU the whole operator is one static-shape program: sort rows
+by (partition, order) once, derive per-row segment/peer geometry with
+scans, compute every window column as vectorized prefix-sum / gather
+arithmetic, and scatter results back to the original row order. Output
+capacity equals input capacity — no sizing syncs, fully fusable into the
+surrounding stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_tpu import types as T
+from spark_tpu.expr import compiler as C
+from spark_tpu.expr import expressions as E
+from spark_tpu.expr.compiler import Env, TV
+from spark_tpu.physical import kernels as K
+from spark_tpu.physical import operators as P
+from spark_tpu.physical.operators import Pipe
+from spark_tpu.types import Field, Schema
+
+_BIG = jnp.iinfo(jnp.int64).max
+
+
+def _seg_scan_max(seg: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Segmented inclusive running max (resets at segment changes)."""
+    return K._seg_scan(seg, x, jnp.maximum)
+
+
+def _seg_scan_min(seg: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    return K._seg_scan(seg, x, jnp.minimum)
+
+
+@dataclass(eq=False)
+class WindowExec(P.PhysicalPlan):
+    """Compute all window columns for one (partition_by, order_by) spec
+    group; multiple spec groups stack as multiple WindowExecs."""
+
+    window_exprs: Tuple[E.Alias, ...]
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = list(cs.fields)
+        for e in self.window_exprs:
+            w = E.strip_alias(e)
+            fields.append(Field(e.name, e.data_type(cs), e.nullable(cs),
+                                E.window_dictionary(w, cs)))
+        return Schema(tuple(fields))
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        cap = pipe.capacity
+        out_cols = dict(pipe.cols)
+        out_order = list(pipe.order)
+
+        # group exprs by identical (partition, order) spec — one sort per
+        # distinct spec (the reference's WindowExec also requires one
+        # sort per child ordering)
+        groups: Dict[tuple, List[E.Alias]] = {}
+        for alias in self.window_exprs:
+            w = E.strip_alias(alias)
+            key = (tuple(E.expr_key(p) for p in w.partition_by),
+                   tuple(E.expr_key(o) for o in w.order_by))
+            groups.setdefault(key, []).append(alias)
+
+        for aliases in groups.values():
+            spec = E.strip_alias(aliases[0])
+            self._compute_group(pipe, spec, aliases, out_cols, out_order,
+                                cap)
+        return Pipe(out_cols, pipe.mask, out_order)
+
+    # -- one (partition, order) spec group ------------------------------------
+
+    def _compute_group(self, pipe: Pipe, spec: E.WindowExpr,
+                       aliases: List[E.Alias], out_cols: Dict[str, TV],
+                       out_order: List[str], cap: int) -> None:
+        env = pipe.env()
+        cs = self.child.schema
+        part_tvs = [C.evaluate(p, env) for p in spec.partition_by]
+        order_tvs = [(C.evaluate(o.child, env), o) for o in spec.order_by]
+
+        sort_keys = [K.SortKey(tv.data, tv.validity, True, True)
+                     for tv in part_tvs]
+        sort_keys += [K.SortKey(tv.data, tv.validity, o.ascending,
+                                o.nulls_first_resolved)
+                      for tv, o in order_tvs]
+        perm = (K.lexsort_permutation(sort_keys, pipe.mask) if sort_keys
+                else K.compaction_permutation(pipe.mask))
+        live = pipe.mask[perm]
+        pos = jnp.arange(cap, dtype=jnp.int64)
+
+        # partition segments over sorted order
+        if part_tvs:
+            skeys = [(tv.data[perm],
+                      None if tv.validity is None else tv.validity[perm])
+                     for tv in part_tvs]
+            seg, _ = K.group_ids_from_sorted(skeys, live)
+        else:
+            # one global partition; dead rows (sorted to the back) get
+            # their own segment so they never affect live geometry
+            seg = jnp.where(live, 0, 1)
+        seg = seg.astype(jnp.int32)
+
+        # per-row partition geometry; seg is MONOTONE in sorted space so
+        # boundaries come from binary search, not scatter reductions
+        # (scatter is pathologically slow on TPU — see kernels.py)
+        seg_start = K.searchsorted(seg, seg, side="left")
+        seg_end = K.searchsorted(seg, seg, side="right") - 1
+        # dead rows sort to the back; the last live row of the trailing
+        # live segment is found by capping with the live count
+        n_live = jnp.sum(live.astype(jnp.int64))
+        seg_end = jnp.minimum(seg_end, jnp.maximum(n_live - 1, 0))
+        rn0 = pos - seg_start  # 0-based row number within partition
+
+        # peer groups: rows equal on ALL order keys (and partition)
+        if order_tvs:
+            part_change = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), seg[1:] != seg[:-1]])
+            okeys = [(tv.data[perm],
+                      None if tv.validity is None else tv.validity[perm])
+                     for tv, _ in order_tvs]
+            ochange = jnp.zeros((cap,), jnp.bool_)
+            for data, validity in okeys:
+                neq = jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_), data[1:] != data[:-1]])
+                if validity is not None:
+                    vneq = jnp.concatenate(
+                        [jnp.ones((1,), jnp.bool_),
+                         validity[1:] != validity[:-1]])
+                    both_null = jnp.concatenate(
+                        [jnp.zeros((1,), jnp.bool_),
+                         (~validity[1:]) & (~validity[:-1])])
+                    neq = (neq & ~both_null) | vneq
+                ochange = ochange | neq
+            head = part_change | ochange
+        else:
+            head = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), seg[1:] != seg[:-1]])
+        peer_id = (jnp.cumsum(head.astype(jnp.int32)) - 1)
+        peer_last = K.searchsorted(peer_id, peer_id, side="right") - 1
+        peer_last = jnp.minimum(peer_last, jnp.maximum(n_live - 1, 0))
+
+        for alias in aliases:
+            w = E.strip_alias(alias)
+            data, validity, dictionary = self._eval_func(
+                w, env, perm, live, pos, seg, seg_start, seg_end, rn0,
+                head, peer_last, cap, cs)
+            # scatter back to original row order
+            odata = jnp.zeros((cap,), dtype=data.dtype).at[perm].set(data)
+            ovalid = (None if validity is None else
+                      jnp.zeros((cap,), jnp.bool_).at[perm].set(validity))
+            dt = w.data_type(cs)
+            out_cols[alias.name] = TV(odata, ovalid, dt, dictionary)
+            out_order.append(alias.name)
+
+    # -- individual functions (all in sorted coordinates) ---------------------
+
+    def _eval_func(self, w: E.WindowExpr, env: Env, perm, live, pos, seg,
+                   seg_start, seg_end, rn0, head, peer_last, cap,
+                   cs) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        fn = w.func
+        if isinstance(fn, E.RowNumber):
+            return (rn0 + 1).astype(jnp.int32), None, None
+        if isinstance(fn, E.Rank):
+            if fn.dense:
+                ch = jnp.cumsum(head.astype(jnp.int64))
+                dense = ch - ch[jnp.clip(seg_start, 0, cap - 1)] + 1
+                return dense.astype(jnp.int32), None, None
+            hp = jnp.where(head, pos, 0)
+            run = _seg_scan_max(seg, hp)
+            return (run - seg_start + 1).astype(jnp.int32), None, None
+        if isinstance(fn, E.NTile):
+            cnt = seg_end - seg_start + 1
+            tile = (rn0 * fn.n) // jnp.maximum(cnt, 1) + 1
+            return tile.astype(jnp.int32), None, None
+        if isinstance(fn, E.LagLead):
+            tv = C.evaluate(fn.child, env)
+            sdata = tv.data[perm]
+            svalid = (None if tv.validity is None else tv.validity[perm])
+            off = fn.offset if fn.lead else -fn.offset
+            src = pos + off
+            in_part = (src >= seg_start) & (src <= seg_end)
+            srcc = jnp.clip(src, 0, cap - 1)
+            data = sdata[srcc]
+            valid = in_part
+            if svalid is not None:
+                valid = valid & svalid[srcc]
+            if fn.default is not None:
+                dtv = C.evaluate(fn.default, env)
+                dval = (dtv.data if dtv.data.ndim == 0
+                        else dtv.data[0])
+                data = jnp.where(in_part, data,
+                                 jnp.asarray(dval, dtype=data.dtype))
+                # the default can itself be NULL (lag(v, 1, NULL))
+                dvalid = dtv.valid_or_true(cap)
+                dv0 = dvalid if dvalid.ndim == 0 else dvalid[0]
+                valid = valid | (~in_part & dv0)
+            return data, valid, tv.dictionary
+        if isinstance(fn, E.AggregateExpression):
+            return self._framed_agg(w, fn, env, perm, live, pos, seg,
+                                    seg_start, seg_end, peer_last, cap, cs)
+        raise NotImplementedError(f"window function {fn}")
+
+    def _frame_bounds(self, w: E.WindowExpr, pos, seg_start, seg_end,
+                      peer_last):
+        """Per-row inclusive [lo, hi] frame positions in sorted space."""
+        frame = w.frame
+        if frame is None:
+            if w.order_by:
+                # SQL default: RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+                # (current row's peers included)
+                return seg_start, peer_last
+            return seg_start, seg_end
+        mode, start, end = frame
+        if mode == "rows":
+            lo = seg_start if start is None else jnp.maximum(
+                seg_start, pos + start)
+            hi = seg_end if end is None else jnp.minimum(seg_end, pos + end)
+            return lo, hi
+        # range mode: only the unbounded/current-row shapes are supported
+        lo = seg_start if start is None else None
+        hi = peer_last if (end == 0) else (seg_end if end is None else None)
+        if lo is None or hi is None:
+            raise NotImplementedError(
+                "RANGE frames with value offsets are not supported")
+        return lo, hi
+
+    def _framed_agg(self, w, fn, env, perm, live, pos, seg, seg_start,
+                    seg_end, peer_last, cap, cs):
+        lo, hi = self._frame_bounds(w, pos, seg_start, seg_end, peer_last)
+        child = fn.child if getattr(fn, "child", None) is not None else None
+        if child is not None:
+            tv = C.evaluate(child, env)
+            sdata = tv.data[perm]
+            ok = live & tv.valid_or_true(cap)[perm]
+        else:  # COUNT(*)
+            sdata = jnp.ones((cap,), jnp.int64)
+            ok = live
+
+        loc = jnp.clip(lo, 0, cap - 1)
+        hic = jnp.clip(hi, 0, cap - 1)
+        empty = hi < lo
+
+        def ranged_sum(x):
+            """Segmented inclusive prefix sums -> arbitrary [lo, hi]."""
+            contrib = jnp.where(ok, x, jnp.zeros((), x.dtype))
+            csum = jnp.cumsum(contrib)
+            pre_lo = jnp.where(lo > 0, csum[jnp.clip(lo - 1, 0, cap - 1)],
+                               jnp.zeros((), csum.dtype))
+            return csum[hic] - pre_lo
+
+        cnt = ranged_sum(jnp.ones((cap,), jnp.int64))
+        cnt = jnp.where(empty, 0, cnt)
+        if isinstance(fn, E.Count):
+            return cnt.astype(jnp.int64), None, None
+        dt = fn.data_type(cs)
+        if isinstance(fn, E.Sum):
+            acc = sdata.astype(C._jnp_dtype(dt))
+            s = jnp.where(empty, 0, ranged_sum(acc))
+            return s, cnt > 0, None
+        if isinstance(fn, E.Avg):
+            s = jnp.where(empty, 0, ranged_sum(sdata.astype(jnp.float64)))
+            return s / jnp.maximum(cnt, 1), cnt > 0, None
+        if isinstance(fn, (E.Min, E.Max)):
+            is_min = isinstance(fn, E.Min)
+            sent = (K._pos_sentinel(sdata.dtype) if is_min
+                    else K._neg_sentinel(sdata.dtype))
+            masked = jnp.where(ok, sdata, sent)
+            # prefix covers whole-partition too (hi = seg_end there);
+            # scatter-based segment_min/max is never worth it (kernels.py)
+            prefix = w.frame is None or w.frame[1] is None
+            if not prefix:
+                raise NotImplementedError(
+                    "sliding min/max window frames are not supported")
+            scan = _seg_scan_min if is_min else _seg_scan_max
+            run = scan(seg, masked)
+            out = run[hic]  # hi is peer_last/seg_end: runs forward
+            return out, cnt > 0, tv.dictionary
+        raise NotImplementedError(f"window aggregate {fn}")
+
+    def node_string(self):
+        return f"Window[{', '.join(str(e) for e in self.window_exprs)}]"
+
+    def plan_key(self):
+        return ("Window",
+                tuple(E.expr_key(e) for e in self.window_exprs),
+                self.child.plan_key())

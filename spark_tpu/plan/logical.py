@@ -258,6 +258,61 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass(eq=False, frozen=True)
+class Window(LogicalPlan):
+    """Append window-function columns to the child's output (reference:
+    plans/logical/basicLogicalOperators.scala Window +
+    execution/window/WindowExec.scala:87). Each entry is an
+    Alias(WindowExpr, out_name); all entries here share nothing — the
+    physical operator groups them by (partition, order) spec."""
+
+    window_exprs: Tuple[E.Alias, ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @cached_property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = list(cs.fields)
+        for e in self.window_exprs:
+            w = E.strip_alias(e)
+            fields.append(Field(e.name, e.data_type(cs), e.nullable(cs),
+                                E.window_dictionary(w, cs)))
+        return Schema(tuple(fields))
+
+    def node_string(self):
+        return f"Window[{', '.join(str(e) for e in self.window_exprs)}]"
+
+
+def project_with_windows(exprs: Tuple[E.Expression, ...],
+                         child: LogicalPlan) -> LogicalPlan:
+    """Build Project(exprs, child), hoisting any WindowExpr into a
+    Window node below the projection (the analyzer's ExtractWindowExpressions
+    rule, reference: analysis/Analyzer.scala)."""
+    win: list = []
+    new_exprs: list = []
+    for e in exprs:
+        if not E.contains_window(e):
+            new_exprs.append(e)
+            continue
+        out_name = e.name
+
+        def repl(x: E.Expression) -> E.Expression:
+            if isinstance(x, E.WindowExpr):
+                nm = f"__w{len(win)}"
+                win.append(E.Alias(x, nm))
+                return E.Col(nm)
+            return x
+
+        ne = E.transform_expr(E.strip_alias(e), repl)
+        new_exprs.append(E.Alias(ne, out_name))
+    if not win:
+        return Project(tuple(exprs), child)
+    return Project(tuple(new_exprs), Window(tuple(win), child))
+
+
+@dataclass(eq=False, frozen=True)
 class Sort(LogicalPlan):
     orders: Tuple[E.SortOrder, ...]
     child: LogicalPlan
@@ -381,15 +436,10 @@ class Join(LogicalPlan):
             rf = [dataclasses.replace(f, nullable=True) for f in rf]
         if self.how in ("right", "full"):
             lf = [dataclasses.replace(f, nullable=True) for f in lf]
-        # duplicate names get a '#2' suffix (must match JoinExec.schema)
-        seen = set()
-        out = []
-        for f in lf + rf:
-            name = f.name
-            while name in seen:
-                name = name + "#2"
-            seen.add(name)
-            out.append(dataclasses.replace(f, name=name))
+        names = E.dedup_pair_names([f.name for f in lf],
+                                   [f.name for f in rf])
+        out = [dataclasses.replace(f, name=n)
+               for f, n in zip(lf + rf, names)]
         return Schema(tuple(out))
 
     def node_string(self):

@@ -38,6 +38,58 @@ def split_conjuncts(e: E.Expression) -> List[E.Expression]:
     return [e]
 
 
+def split_disjuncts(e: E.Expression) -> List[E.Expression]:
+    if isinstance(e, E.Or):
+        return split_disjuncts(e.left) + split_disjuncts(e.right)
+    return [e]
+
+
+def combine_disjuncts(parts: List[E.Expression]) -> E.Expression:
+    out = parts[0]
+    for p in parts[1:]:
+        out = E.Or(out, p)
+    return out
+
+
+def factor_or_common(e: E.Expression) -> E.Expression:
+    """(A AND X) OR (A AND Y) -> A AND (X OR Y): factor conjuncts common
+    to every OR branch (distributivity holds under Kleene 3-valued logic).
+    Unlocks equi-key extraction for TPC-H q19-style predicates where the
+    join key equality is repeated inside each OR branch (reference:
+    optimizer/expressions.scala BooleanSimplification 'common factor
+    extraction' case)."""
+
+    def fn(node: E.Expression) -> E.Expression:
+        if not isinstance(node, E.Or):
+            return node
+        branches = split_disjuncts(node)
+        conj_lists = [split_conjuncts(b) for b in branches]
+        key_lists = [[E.expr_key(c) for c in cl] for cl in conj_lists]
+        common = set(key_lists[0])
+        for kl in key_lists[1:]:
+            common &= set(kl)
+        if not common:
+            return node
+        factored = [c for c, k in zip(conj_lists[0], key_lists[0])
+                    if k in common]
+        # drop duplicates of an already-factored conjunct within a branch
+        rest_branches: List[E.Expression] = []
+        any_true = False
+        for cl, kl in zip(conj_lists, key_lists):
+            remaining = [c for c, k in zip(cl, kl) if k not in common]
+            if not remaining:
+                any_true = True
+            else:
+                rest_branches.append(combine_conjuncts(remaining))
+        if any_true:
+            # one branch reduced to TRUE: OR-part vanishes entirely
+            return combine_conjuncts(factored)
+        return combine_conjuncts(factored +
+                                 [combine_disjuncts(rest_branches)])
+
+    return E.transform_expr(e, fn)
+
+
 def combine_conjuncts(parts: List[E.Expression]) -> E.Expression:
     out = parts[0]
     for p in parts[1:]:
@@ -230,6 +282,83 @@ def extract_equi_joins(plan: L.LogicalPlan) -> L.LogicalPlan:
     return plan.transform_up(fn)
 
 
+def extract_condition_keys(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Move equality conjuncts of a Join's ON condition into equi-join
+    keys, for EVERY join type (reference: planning/patterns.scala
+    ExtractEquiJoinKeys operates on the full join condition). Without
+    this, semi/anti/outer joins whose keys live only in the condition
+    degrade to all-pairs nested loops. The condition is expressed in the
+    join's OUTPUT name space (right-side duplicates carry '#2' suffixes);
+    extracted right keys are mapped back to right-source names. Safe for
+    outer joins: keys and condition are both part of the match predicate,
+    and unmatched-row padding is unaffected."""
+
+    def fn(node: L.LogicalPlan) -> L.LogicalPlan:
+        if not isinstance(node, L.Join) or node.condition is None:
+            return node
+        if node.how == "cross":
+            return node
+        # the condition is evaluated over the joined PAIR, whose namespace
+        # is left names + '#2'-deduped right names — NOT node.schema
+        # (which is left-only for semi/anti joins)
+        left_names = list(node.left.schema.names)
+        right_names = list(node.right.schema.names)
+        pair_names = E.dedup_pair_names(left_names, right_names)
+        n_l = len(left_names)
+        left_out = set(pair_names[:n_l])
+        right_out_map = dict(zip(pair_names[n_l:], right_names))
+
+        def to_right_src(e: E.Expression) -> E.Expression:
+            def sub(x):
+                if isinstance(x, E.Col) and x.col_name in right_out_map:
+                    return E.Col(right_out_map[x.col_name])
+                return x
+
+            return E.transform_expr(e, sub)
+
+        lkeys = list(node.left_keys)
+        rkeys = list(node.right_keys)
+        keep: List[E.Expression] = []
+        changed = False
+        for c in split_conjuncts(factor_or_common(node.condition)):
+            if isinstance(c, E.Cmp) and c.op == "==":
+                lr, rr = c.left.references(), c.right.references()
+                if lr and lr <= left_out and rr and rr <= set(right_out_map):
+                    lkeys.append(c.left)
+                    rkeys.append(to_right_src(c.right))
+                    changed = True
+                    continue
+                if rr and rr <= left_out and lr and lr <= set(right_out_map):
+                    lkeys.append(c.right)
+                    rkeys.append(to_right_src(c.left))
+                    changed = True
+                    continue
+            keep.append(c)
+        if not changed:
+            return node
+        return dataclasses.replace(
+            node, left_keys=tuple(lkeys), right_keys=tuple(rkeys),
+            condition=combine_conjuncts(keep) if keep else None)
+
+    return plan.transform_up(fn)
+
+
+def simplify_booleans(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Factor common conjuncts out of OR trees in every Filter so that
+    predicate pushdown and equi-key extraction see them as top-level
+    conjuncts (q19's `p_partkey = l_partkey` lives inside each OR
+    branch). Reference: optimizer/expressions.scala BooleanSimplification."""
+
+    def fn(node: L.LogicalPlan) -> L.LogicalPlan:
+        if isinstance(node, L.Filter):
+            new_cond = factor_or_common(node.condition)
+            if new_cond is not node.condition:
+                return L.Filter(new_cond, node.child)
+        return node
+
+    return plan.transform_up(fn)
+
+
 def prune_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
     def fn(node: L.LogicalPlan) -> L.LogicalPlan:
         if isinstance(node, L.Filter) and isinstance(node.condition, E.Literal):
@@ -284,6 +413,16 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
             child_req = set()
             for e in node.groupings + node.aggregates:
                 child_req |= e.references()
+            return dataclasses.replace(
+                node, child=prune(node.child, child_req))
+        if isinstance(node, L.Window):
+            win_names = {e.name for e in node.window_exprs}
+            child_req = {n for n in required if n not in win_names}
+            for e in node.window_exprs:
+                child_req |= e.references()
+            child_req &= set(node.child.schema.names)
+            if not child_req:
+                child_req = set(node.child.schema.names)
             return dataclasses.replace(
                 node, child=prune(node.child, child_req))
         if isinstance(node, (L.Sort, L.Limit, L.Distinct, L.SubqueryAlias,
@@ -351,8 +490,10 @@ Rule = Callable[[L.LogicalPlan], L.LogicalPlan]
 
 _FIXED_POINT_BATCH: Tuple[Rule, ...] = (
     constant_folding,
+    simplify_booleans,
     push_down_predicates,
     extract_equi_joins,
+    extract_condition_keys,
     collapse_projects,
     prune_filters,
 )

@@ -80,6 +80,7 @@ def _strip_correlated(
 
     def go(node: L.LogicalPlan, under_agg: bool) -> L.LogicalPlan:
         nonlocal below_agg
+        before = len(collected)
         child_under = under_agg or isinstance(node, L.Aggregate)
         children = tuple(go(c, child_under) for c in node.children())
         node = node.with_children(children) if children else node
@@ -93,6 +94,21 @@ def _strip_correlated(
                     below_agg = True
                 return L.Filter(_combine(rest), node.child) if rest \
                     else node.child
+        if isinstance(node, L.Project):
+            # correlated conjuncts collected in this subtree become join
+            # keys/conditions ABOVE the subquery plan — widen the
+            # projection so the inner columns they reference survive
+            # (reference: DecorrelateInnerQuery threads attributes up)
+            needed: set = set()
+            for p in collected[before:]:
+                needed |= p.references()  # OuterRefs contribute nothing
+            missing = [n for n in needed
+                       if n not in set(node.schema.names)
+                       and n in set(node.child.schema.names)]
+            if missing:
+                node = L.Project(
+                    node.exprs + tuple(E.Col(n) for n in sorted(missing)),
+                    node.child)
         return node
 
     return go(plan, False), collected, below_agg
@@ -129,14 +145,8 @@ def _join_condition(residual: List[E.Expression], left_names,
     (logical.Join.schema dedup). Rewrite both."""
     if not residual:
         return None
-    seen = set(left_names)
-    rename = {}
-    for n in right_names:
-        out = n
-        while out in seen:
-            out = out + "#2"
-        seen.add(out)
-        rename[n] = out
+    pair = E.dedup_pair_names(left_names, right_names)
+    rename = dict(zip(right_names, pair[len(list(left_names)):]))
 
     def fix(e: E.Expression) -> E.Expression:
         def fn(x):
@@ -178,9 +188,10 @@ def _apply_exists(plan: L.LogicalPlan, ex: E.Exists) -> L.LogicalPlan:
 
 def _apply_in(plan: L.LogicalPlan, isq: E.InSubquery) -> L.LogicalPlan:
     """[NOT] IN (subquery) as a semi/anti join on value equality (+ any
-    correlated equalities). NOTE: NOT IN with NULLs in the subquery
-    result follows the join (row-keeping) semantics, not SQL's
-    three-valued 'all NULL comparisons' rule — matching keys only."""
+    correlated equalities). NOT IN is null-aware for the uncorrelated
+    case (reference: RewritePredicateSubquery's null-aware anti join):
+    a NULL anywhere in the subquery result, or a NULL probe value with a
+    non-empty subquery, yields UNKNOWN — the row is dropped."""
     sub = rewrite_subqueries(isq.plan)
     stripped, corr, below_agg = _strip_correlated(sub)
     if below_agg:
@@ -193,8 +204,55 @@ def _apply_in(plan: L.LogicalPlan, isq: E.InSubquery) -> L.LogicalPlan:
     cond = _join_condition(residual, plan.schema.names,
                            stripped.schema.names)
     how = "left_anti" if isq.negated else "left_semi"
-    return L.Join(plan, stripped, how, tuple(outer_keys),
-                  tuple(inner_keys), cond)
+    joined = L.Join(plan, stripped, how, tuple(outer_keys),
+                    tuple(inner_keys), cond)
+    if not isq.negated:
+        return joined
+    if corr:
+        # per-group null-awareness over a nullable inner column is not
+        # implemented; with a non-nullable inner column the anti join is
+        # exact except for a NULL probe vs a non-empty group (UNKNOWN ->
+        # drop), handled via per-group counts when the probe is nullable
+        if stripped.schema.fields[0].nullable:
+            raise NotImplementedError(
+                "correlated NOT IN over a nullable subquery column")
+        probe_nullable = True
+        try:
+            probe_nullable = isq.child.nullable(plan.schema)
+        except Exception:
+            pass
+        if not probe_nullable:
+            return joined
+        corr_outer = outer_keys[1:]
+        corr_inner = inner_keys[1:]
+        n_name = f"__nin{next(_sq_counter)}_n"
+        key_aliases = [E.Alias(k, f"{n_name}_k{j}")
+                      for j, k in enumerate(corr_inner)]
+        counts = L.Aggregate(tuple(corr_inner),
+                             tuple(key_aliases) +
+                             (E.Alias(E.Count(None), n_name),), stripped)
+        with_counts = L.Join(joined, counts, "left", tuple(corr_outer),
+                             tuple(E.Col(a.alias_name)
+                                   for a in key_aliases))
+        group_empty = E.IsNull(E.Col(n_name))
+        keep = E.Or(group_empty, E.Not(E.IsNull(isq.child)))
+        return L.Project(tuple(E.Col(n) for n in plan.schema.names),
+                         L.Filter(keep, with_counts))
+    # uncorrelated NOT IN: attach subquery row/non-null counts and apply
+    # three-valued logic: empty subquery -> keep everything; any NULL in
+    # the subquery -> keep nothing; NULL probe + non-empty -> drop row
+    i = next(_sq_counter)
+    n_name, nn_name = f"__nin{i}_n", f"__nin{i}_nn"
+    counts = L.Aggregate(
+        (), (E.Alias(E.Count(None), n_name),
+             E.Alias(E.Count(E.Col(value_col)), nn_name)), stripped)
+    with_counts = L.Join(joined, counts, "cross", (), ())
+    empty = E.Cmp("==", E.Col(n_name), E.Literal(0))
+    no_nulls = E.Cmp("==", E.Col(n_name), E.Col(nn_name))
+    probe_ok = E.Not(E.IsNull(isq.child))
+    keep = E.Or(empty, E.And(no_nulls, probe_ok))
+    return L.Project(tuple(E.Col(n) for n in plan.schema.names),
+                     L.Filter(keep, with_counts))
 
 
 def _apply_scalar(
@@ -207,8 +265,18 @@ def _apply_scalar(
     stripped, corr, _ = _strip_correlated(sub)
     if not corr:
         first = stripped.schema.names[0]
-        renamed = L.Project((E.Alias(E.Col(first), out_name),), stripped)
-        return L.Join(plan, renamed, "cross", (), ()), E.Col(out_name)
+        if isinstance(stripped, L.Aggregate) and not stripped.groupings:
+            # already exactly one row — a straight cross join is safe
+            renamed = L.Project((E.Alias(E.Col(first), out_name),), stripped)
+            return L.Join(plan, renamed, "cross", (), ()), E.Col(out_name)
+        # general relation: reduce to one row so an empty result yields
+        # NULL instead of dropping all outer rows (SQL scalar-subquery
+        # semantics; reference: RewriteCorrelatedScalarSubquery notes).
+        # Deviation: >1 row takes the first instead of raising.
+        one_row = L.Aggregate(
+            (), (E.Alias(E.First(E.Col(first)), out_name),),
+            L.Limit(1, stripped))
+        return L.Join(plan, one_row, "cross", (), ()), E.Col(out_name)
     # correlated: the top of the subquery must be a global aggregate;
     # group it by the correlation columns and LEFT JOIN on them
     # (reference: RewriteCorrelatedScalarSubquery + constructLeftJoins)
@@ -222,13 +290,20 @@ def _apply_scalar(
             "non-equality correlation in scalar subquery")
     key_aliases = [E.Alias(k, f"__sqk{i}_{j}")
                    for j, k in enumerate(inner_keys)]
-    agg_out = E.Alias(E.strip_alias(stripped.aggregates[0]), out_name)
+    agg_expr = E.strip_alias(stripped.aggregates[0])
+    agg_out = E.Alias(agg_expr, out_name)
     grouped = L.Aggregate(tuple(inner_keys),
                           tuple(key_aliases) + (agg_out,),
                           stripped.child)
     joined = L.Join(plan, grouped, "left", tuple(outer_keys),
                     tuple(E.Col(a.alias_name) for a in key_aliases))
-    return joined, E.Col(out_name)
+    result: E.Expression = E.Col(out_name)
+    if isinstance(agg_expr, E.Count):
+        # COUNT over an empty correlated group is 0, but the grouped LEFT
+        # JOIN produces NULL for groups with no rows (reference:
+        # RewriteCorrelatedScalarSubquery's COUNT bug handling)
+        result = E.Coalesce((result, E.Literal(0)))
+    return joined, result
 
 
 def _rewrite_filter(node: L.Filter) -> L.LogicalPlan:
@@ -270,17 +345,46 @@ def _rewrite_filter(node: L.Filter) -> L.LogicalPlan:
     return plan
 
 
+def _rewrite_project(node: L.Project) -> L.LogicalPlan:
+    """Scalar subqueries in SELECT position (reference:
+    RewriteCorrelatedScalarSubquery handles Project as well as Filter)."""
+    plan = node.child
+    new_exprs: List[E.Expression] = []
+    for e in node.exprs:
+        if not E.contains_subquery(e):
+            new_exprs.append(e)
+            continue
+        out_name = e.name
+
+        def replace(x: E.Expression) -> E.Expression:
+            nonlocal plan
+            if isinstance(x, E.ScalarSubquery):
+                plan, col = _apply_scalar(plan, x)
+                return col
+            if isinstance(x, (E.Exists, E.InSubquery)):
+                raise NotImplementedError(
+                    "EXISTS/IN subquery in SELECT position")
+            return x
+
+        ne = E.transform_expr(E.strip_alias(e), replace)
+        new_exprs.append(E.Alias(ne, out_name))
+    return L.Project(tuple(new_exprs), plan)
+
+
 def rewrite_subqueries(plan: L.LogicalPlan) -> L.LogicalPlan:
     """Remove every SubqueryExpression (bottom-up; nested subqueries are
-    rewritten when their enclosing Filter is processed)."""
+    rewritten when their enclosing Filter/Project is processed)."""
 
     def fn(node: L.LogicalPlan) -> L.LogicalPlan:
         if isinstance(node, L.Filter) and E.contains_subquery(node.condition):
             return _rewrite_filter(node)
+        if isinstance(node, L.Project) and any(
+                E.contains_subquery(e) for e in node.exprs):
+            return _rewrite_project(node)
         for e in node.expressions():
             if E.contains_subquery(e):
                 raise NotImplementedError(
-                    f"subquery expression outside WHERE/HAVING: {e}")
+                    f"subquery expression outside WHERE/HAVING/SELECT: {e}")
         return node
 
     return plan.transform_up(fn)

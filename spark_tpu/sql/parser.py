@@ -425,8 +425,104 @@ class _ExprParser:
     _AGG_FNS = {"SUM": E.Sum, "AVG": E.Avg, "MIN": E.Min, "MAX": E.Max}
 
     def _parse_function(self, name_tok: Token) -> E.Expression:
+        e = self._parse_function_inner(name_tok)
+        if self.at_keyword("OVER"):
+            self.next()
+            return self._parse_window_spec(e)
+        if isinstance(e, (E.RowNumber, E.Rank, E.NTile, E.LagLead)):
+            raise SQLParseError(
+                f"{name_tok.value} requires an OVER clause at "
+                f"{name_tok.pos}")
+        return e
+
+    def _parse_window_spec(self, func: E.Expression) -> E.WindowExpr:
+        """OVER ( [PARTITION BY ...] [ORDER BY ...] [ROWS|RANGE BETWEEN
+        bound AND bound] ) (reference grammar: SqlBaseParser.g4
+        windowSpec)."""
+        self.expect("(")
+        partition: List[E.Expression] = []
+        orders: List[E.SortOrder] = []
+        frame = None
+        if self.at_keyword("PARTITION"):
+            self.next()
+            self.expect("BY")
+            partition.append(self.parse())
+            while self.accept(","):
+                partition.append(self.parse())
+        if self.at_keyword("ORDER"):
+            self.next()
+            self.expect("BY")
+            while True:
+                e = self.parse()
+                asc = True
+                if self.accept("DESC"):
+                    asc = False
+                else:
+                    self.accept("ASC")
+                nulls_first = None
+                if self.accept("NULLS"):
+                    nulls_first = self.next().upper == "FIRST"
+                orders.append(E.SortOrder(e, asc, nulls_first))
+                if not self.accept(","):
+                    break
+        if self.at_keyword("ROWS", "RANGE"):
+            mode = self.next().upper.lower()
+
+            def bound() -> Tuple[str, Optional[int]]:
+                if self.accept("UNBOUNDED"):
+                    side = self.next()
+                    if side.upper not in ("PRECEDING", "FOLLOWING"):
+                        raise SQLParseError(
+                            f"expected PRECEDING or FOLLOWING at "
+                            f"{side.pos}: {side.value!r}")
+                    return side.upper, None
+                if self.accept("CURRENT"):
+                    self.expect("ROW")
+                    return "CURRENT", 0
+                n = self._int_literal()
+                side = self.next()
+                if side.upper not in ("PRECEDING", "FOLLOWING"):
+                    raise SQLParseError(
+                        f"expected PRECEDING or FOLLOWING at "
+                        f"{side.pos}: {side.value!r}")
+                return side.upper, n
+
+            if self.accept("BETWEEN"):
+                s_side, s_n = bound()
+                self.expect("AND")
+                e_side, e_n = bound()
+            else:
+                s_side, s_n = bound()
+                e_side, e_n = "CURRENT", 0
+            start = None if s_n is None else (
+                -s_n if s_side == "PRECEDING" else s_n)
+            end = None if e_n is None else (
+                -e_n if e_side == "PRECEDING" else e_n)
+            frame = (mode, start, end)
+        self.expect(")")
+        return E.WindowExpr(func, tuple(partition), tuple(orders), frame)
+
+    def _parse_function_inner(self, name_tok: Token) -> E.Expression:
         name = name_tok.upper
         self.expect("(")
+        if name in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
+            self.expect(")")
+            if name == "ROW_NUMBER":
+                return E.RowNumber()
+            return E.Rank(dense=(name == "DENSE_RANK"))
+        if name == "NTILE":
+            n = self._int_literal()
+            self.expect(")")
+            return E.NTile(n)
+        if name in ("LAG", "LEAD"):
+            e = self.parse()
+            offset, default = 1, None
+            if self.accept(","):
+                offset = self._int_literal()
+                if self.accept(","):
+                    default = self.parse()
+            self.expect(")")
+            return E.LagLead(e, offset, default, lead=(name == "LEAD"))
         if name == "COUNT":
             if self.peek().kind == "op" and self.peek().value == "*":
                 self.next()
@@ -889,6 +985,11 @@ class _StmtParser:
             self._sync(ep)
 
         has_agg = any(E.contains_aggregate(e) for e in select_exprs)
+        has_window = any(E.contains_window(e) for e in select_exprs)
+        if has_window and (group_exprs or has_agg or having is not None):
+            raise NotImplementedError(
+                "window functions combined with GROUP BY/HAVING in the "
+                "same SELECT are not supported yet")
         if group_exprs or has_agg or having is not None:
             outputs = list(select_exprs)
             having_cond = None
@@ -918,7 +1019,7 @@ class _StmtParser:
                 plan = L.Project(
                     tuple(E.Col(e.name) for e in select_exprs), plan)
         else:
-            plan = L.Project(tuple(select_exprs), plan)
+            plan = L.project_with_windows(tuple(select_exprs), plan)
 
         if distinct:
             plan = L.Distinct(plan)

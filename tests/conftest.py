@@ -20,6 +20,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache: XLA CPU compiles are multi-second on this
+# host; without the disk cache the TPC-H suite pays ~100 compiles/query.
+from spark_tpu.api.session import _enable_compilation_cache  # noqa: E402
+
+_enable_compilation_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

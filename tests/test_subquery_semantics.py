@@ -1,0 +1,91 @@
+"""SQL three-valued-logic corner cases in subquery rewriting (reference:
+optimizer/subquery.scala RewritePredicateSubquery null-aware anti join,
+RewriteCorrelatedScalarSubquery COUNT handling)."""
+
+
+
+def test_not_in_with_null_in_subquery(spark):
+    spark.createDataFrame(
+        [{"x": 1}, {"x": 2}, {"x": 3}]).createOrReplaceTempView("tvl_l")
+    spark.createDataFrame(
+        [{"y": 1}, {"y": None}]).createOrReplaceTempView("tvl_r")
+    # NULL in the subquery: NOT IN is never TRUE -> empty result
+    rows = spark.sql(
+        "select x from tvl_l where x not in (select y from tvl_r)").collect()
+    assert rows == []
+
+
+def test_not_in_without_nulls(spark):
+    spark.createDataFrame(
+        [{"x": 1}, {"x": 2}, {"x": 3}]).createOrReplaceTempView("tv2_l")
+    spark.createDataFrame([{"y": 1}]).createOrReplaceTempView("tv2_r")
+    rows = spark.sql(
+        "select x from tv2_l where x not in (select y from tv2_r)").collect()
+    assert sorted(r.x for r in rows) == [2, 3]
+
+
+def test_not_in_empty_subquery(spark):
+    spark.createDataFrame(
+        [{"x": 1}, {"x": None}]).createOrReplaceTempView("tv3_l")
+    spark.createDataFrame([{"y": 5}]).createOrReplaceTempView("tv3_r")
+    # empty subquery: everything qualifies, even NULL probes
+    rows = spark.sql(
+        "select x from tv3_l where x not in "
+        "(select y from tv3_r where y > 100)").collect()
+    assert len(rows) == 2
+
+
+def test_not_in_null_probe(spark):
+    spark.createDataFrame(
+        [{"x": 1}, {"x": None}]).createOrReplaceTempView("tv4_l")
+    spark.createDataFrame([{"y": 5}]).createOrReplaceTempView("tv4_r")
+    # NULL probe vs non-empty subquery -> UNKNOWN -> dropped
+    rows = spark.sql(
+        "select x from tv4_l where x not in (select y from tv4_r)").collect()
+    assert [r.x for r in rows] == [1]
+
+
+def test_scalar_subquery_empty_yields_null(spark):
+    spark.createDataFrame([{"x": 1}, {"x": 2}]).createOrReplaceTempView("sv_l")
+    spark.createDataFrame([{"y": 9}]).createOrReplaceTempView("sv_r")
+    rows = spark.sql(
+        "select x, (select y from sv_r where y > 100) as s from sv_l"
+    ).collect()
+    assert len(rows) == 2 and all(r.s is None for r in rows)
+
+
+def test_correlated_count_empty_group_is_zero(spark):
+    spark.createDataFrame(
+        [{"k": 1}, {"k": 2}]).createOrReplaceTempView("cc_l")
+    spark.createDataFrame(
+        [{"k": 1, "v": 10}]).createOrReplaceTempView("cc_r")
+    rows = spark.sql(
+        "select k, (select count(*) from cc_r where cc_r.k = cc_l.k) as c "
+        "from cc_l order by k").collect()
+    assert [(r.k, r.c) for r in rows] == [(1, 1), (2, 0)]
+
+
+def test_not_in_null_literal_probe(spark):
+    spark.createDataFrame(
+        [{"k": 1}, {"k": 2}, {"k": 3}]).createOrReplaceTempView("tv5_l")
+    spark.createDataFrame([{"y": 7}]).createOrReplaceTempView("tv5_r")
+    # NULL NOT IN (non-empty) is UNKNOWN for every row -> empty result
+    rows = spark.sql(
+        "select k from tv5_l where null not in (select y from tv5_r)"
+    ).collect()
+    assert rows == []
+
+
+def test_correlated_not_in_null_probe(spark):
+    spark.createDataFrame(
+        [{"k": 1, "x": 5}, {"k": 1, "x": None}, {"k": 2, "x": None}]
+    ).createOrReplaceTempView("tv6_l")
+    spark.createDataFrame(
+        [{"k": 1, "y": 9}]).createOrReplaceTempView("tv6_r")
+    # (k=1, x=5): 5 != 9 -> TRUE, kept. (k=1, x=NULL): group non-empty ->
+    # UNKNOWN, dropped. (k=2, x=NULL): group empty -> TRUE, kept.
+    rows = spark.sql(
+        "select k, x from tv6_l where x not in "
+        "(select y from tv6_r where tv6_r.k = tv6_l.k)").collect()
+    assert sorted([(r.k, r.x) for r in rows],
+                  key=lambda t: (t[0], t[1] is None)) == [(1, 5), (2, None)]
