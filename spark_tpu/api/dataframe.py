@@ -269,6 +269,9 @@ class DataFrame:
     # ---- actions -----------------------------------------------------------
 
     def _execute(self):
+        from spark_tpu import metrics
+
+        metrics.query_start(self._plan.node_string())
         ex = getattr(self._session, "mesh_executor", None) \
             if self._session is not None else None
         if ex is not None:

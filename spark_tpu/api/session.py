@@ -149,7 +149,7 @@ class SparkSession:
         if self._mesh_executor is None:
             from spark_tpu.parallel.executor import MeshExecutor
 
-            self._mesh_executor = MeshExecutor(self._mesh)
+            self._mesh_executor = MeshExecutor(self._mesh, conf=self.conf)
         return self._mesh_executor
 
     # -- builder is reset-safe for tests

@@ -56,6 +56,11 @@ REPARTITION_SLACK = register(
     "Per-destination capacity slack factor for hash repartition "
     "(all_to_all requires static per-pair sizes).", int)
 
+EVENT_LOG_DIR = register(
+    "spark.eventLog.dir", "",
+    "When set, per-stage execution events are appended as JSONL under "
+    "this directory (reference: EventLoggingListener.scala:48).", str)
+
 
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""

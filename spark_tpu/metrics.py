@@ -1,0 +1,106 @@
+"""Per-stage metrics + JSON event log.
+
+Analogue of the reference's SQLMetrics + event logging
+(sql/core/.../execution/metric/SQLMetrics.scala:40,
+core/.../scheduler/EventLoggingListener.scala:48), collapsed to what a
+single-process driver needs: every executed stage (fused program or
+blocking operator) appends an event carrying operator, capacities and
+wall time. The in-memory ring is inspectable via ``recent()``/
+``last_query()``; setting ``spark.eventLog.dir`` also appends JSONL to
+disk so hung or slow stages are visible post-mortem (the round-2 q19/q21
+hangs shipped precisely because nothing recorded per-stage timing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_LOCK = threading.Lock()
+_EVENTS: deque = deque(maxlen=4096)
+_QUERY_MARKS: deque = deque(maxlen=64)
+_counter = 0
+
+
+_PATH_CACHE: Dict[str, Optional[str]] = {}
+
+
+def _log_path() -> Optional[str]:
+    from spark_tpu.api.session import SparkSession
+
+    sess = SparkSession._active
+    if sess is None:
+        return None
+    try:
+        d = sess.conf.get("spark.eventLog.dir")
+    except KeyError:
+        return None
+    if not d:
+        return None
+    # resolve + mkdir once per configured directory
+    if d not in _PATH_CACHE:
+        os.makedirs(d, exist_ok=True)
+        _PATH_CACHE[d] = os.path.join(d, "events.jsonl")
+    return _PATH_CACHE[d]
+
+
+def record(kind: str, **fields: Any) -> None:
+    global _counter
+    ev = {"n": _counter, "ts": round(time.time(), 4), "kind": kind}
+    ev.update(fields)
+    path = _log_path()
+    with _LOCK:
+        _counter += 1
+        _EVENTS.append(ev)
+        if path is not None:
+            with open(path, "a") as f:
+                f.write(json.dumps(ev) + "\n")
+
+
+def query_start(description: str) -> int:
+    with _LOCK:
+        mark = _counter
+    _QUERY_MARKS.append(mark)
+    record("query_start", description=description)
+    return mark
+
+
+def recent(n: int = 100) -> List[Dict[str, Any]]:
+    with _LOCK:
+        return list(_EVENTS)[-n:]
+
+
+def last_query() -> List[Dict[str, Any]]:
+    """Events since the last query_start (inclusive)."""
+    with _LOCK:
+        evs = list(_EVENTS)
+    mark = _QUERY_MARKS[-1] if _QUERY_MARKS else 0
+    return [e for e in evs if e["n"] >= mark]
+
+
+def reset() -> None:
+    with _LOCK:
+        _EVENTS.clear()
+        _QUERY_MARKS.clear()
+
+
+class stage_timer:
+    """Context manager recording one stage execution event."""
+
+    def __init__(self, op: str, **fields: Any):
+        self.op = op
+        self.fields = fields
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        ms = (time.perf_counter() - self.t0) * 1e3
+        record("stage", op=self.op, ms=round(ms, 2),
+               error=None if exc is None else repr(exc), **self.fields)
+        return False

@@ -120,13 +120,36 @@ class _CompactExec(P.PhysicalPlan):
         return ("Compact", self.new_capacity, self.child.plan_key())
 
 
+def _estimated_bytes(sb) -> int:
+    """Estimated device bytes of a join build side: total capacity x
+    per-row width from the schema (the size estimate the reference takes
+    from plan statistics, SizeInBytesOnlyStatsPlanVisitor)."""
+    from spark_tpu.expr.compiler import _jnp_dtype
+
+    width = 0
+    for f in sb.schema.fields:
+        try:
+            width += np.dtype(_jnp_dtype(f.dtype)).itemsize
+        except Exception:
+            width += 8
+        if f.nullable:
+            width += 1
+    return int(sb.capacity) * width
+
+
 class MeshExecutor:
     """Plans and runs logical plans over a device mesh."""
 
-    def __init__(self, mesh: Mesh, broadcast_threshold: int = 1 << 16):
+    def __init__(self, mesh: Mesh, broadcast_threshold: Optional[int] = None,
+                 conf=None):
+        from spark_tpu import conf as _conf
+
         self.mesh = mesh
         self.d = mesh_size(mesh)
-        #: rows (capacity) under which a join build side is broadcast
+        self.conf = conf if conf is not None else _conf.RuntimeConf()
+        #: bytes under which a join build side is broadcast (reference:
+        #: SQLConf spark.sql.autoBroadcastJoinThreshold, in BYTES). The
+        #: legacy row-count argument overrides when given (tests).
         self.broadcast_threshold = broadcast_threshold
         # weak keys: entries die with their Batch, and a live entry pins
         # its key so the mapping can never alias a recycled object
@@ -256,6 +279,13 @@ class MeshExecutor:
         return dataclasses.replace(plan, **fields) if changed else plan
 
     def _run_stage(self, plan: P.PhysicalPlan) -> ShardedBatch:
+        from spark_tpu import metrics
+
+        with metrics.stage_timer("stage", mesh=self.d,
+                                 node=plan.node_string()):
+            return self._run_stage_inner(plan)
+
+    def _run_stage_inner(self, plan: P.PhysicalPlan) -> ShardedBatch:
         scans: List[D.ShardScanExec] = []
         _collect_shard_scans(plan, scans)
         key = (plan.plan_key(), self.d, self.mesh.devices.flat[0].platform)
@@ -308,8 +338,16 @@ class MeshExecutor:
         if how == "cross":
             return self._run_cross(jb, left_sb, right_sb)
 
+        if self.broadcast_threshold is not None:  # legacy row threshold
+            small_build = right_sb.capacity <= self.broadcast_threshold
+        else:
+            from spark_tpu import conf as _conf
+
+            # read per-join so spark.conf.set takes effect immediately
+            small_build = (_estimated_bytes(right_sb)
+                           <= self.conf.get(_conf.BROADCAST_THRESHOLD))
         broadcast = (how in ("inner", "left", "left_semi", "left_anti")
-                     and right_sb.capacity <= self.broadcast_threshold)
+                     and small_build)
 
         # Evaluate the key expressions once (a tiny projection stage) —
         # the EXECUTED schema carries the true dictionaries of computed
@@ -334,7 +372,7 @@ class MeshExecutor:
                 key_union_dicts=union_dicts))
 
         need_count = not (how in ("left_semi", "left_anti")
-                          and jb.condition is None)
+                          and jb.condition is None and mins is not None)
         pair_cap = 0
         if need_count:
             cnt_plan = D.JoinCountExec(
@@ -408,6 +446,8 @@ class MeshExecutor:
                     ranges.append(mx - mn + 1)
             total *= ranges[-1]
             if total > (1 << 62):
-                raise NotImplementedError(
-                    "multi-key join exceeds int64 packing range")
+                # exact packing impossible: switch the whole join to the
+                # hash-with-verify fallback (reference:
+                # HashedRelation.scala:208 probe-then-confirm)
+                return None, None
         return tuple(mins), tuple(ranges)

@@ -525,12 +525,17 @@ def join_output_schema(left: Schema, right: Schema, how: str) -> Schema:
 def packed_join_keys(lpipe: Pipe, rpipe: Pipe,
                      left_keys: Tuple[E.Expression, ...],
                      right_keys: Tuple[E.Expression, ...],
-                     mins: Tuple[int, ...], ranges: Tuple[int, ...]):
+                     mins, ranges):
     """Pack equi-join keys into one int64 per row using STATIC per-key
     min/range stats (host-supplied from a stats pass — the AQE runtime
     statistics pattern, reference: adaptive/AdaptiveSparkPlanExec.scala:247).
     Strings pack via trace-time unified dictionaries. Collision-free by
-    construction, unlike hashing."""
+    construction, unlike hashing. ``mins is None`` switches to the
+    hash-combined fallback (wide int64 ranges); callers must then verify
+    candidate pairs by exact key equality. Returns
+    (lkey, lvalid, rkey, rvalid, prepped) where prepped holds the
+    translated per-key arrays for verification."""
+    hashed = mins is None
     lenv, renv = lpipe.env(), rpipe.env()
     lks = [C.evaluate(k, lenv) for k in left_keys]
     rks = [C.evaluate(k, renv) for k in right_keys]
@@ -538,7 +543,8 @@ def packed_join_keys(lpipe: Pipe, rpipe: Pipe,
     rcomb = jnp.zeros((rpipe.capacity,), dtype=jnp.int64)
     lvalid = jnp.ones((lpipe.capacity,), dtype=jnp.bool_)
     rvalid = jnp.ones((rpipe.capacity,), dtype=jnp.bool_)
-    for (lt, rt), mn, rg in zip(zip(lks, rks), mins, ranges):
+    prepped = []
+    for ki, (lt, rt) in enumerate(zip(lks, rks)):
         if isinstance(lt.dtype, T.StringType) or isinstance(rt.dtype, T.StringType):
             _, (tl, tr) = C.unify_dictionaries(
                 (lt.dictionary or (), rt.dictionary or ()))
@@ -547,13 +553,19 @@ def packed_join_keys(lpipe: Pipe, rpipe: Pipe,
         else:
             ld = lt.data.astype(jnp.int64)
             rd = rt.data.astype(jnp.int64)
-        lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
-        rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
+        prepped.append((ld, rd))
+        if not hashed:
+            mn, rg = mins[ki], ranges[ki]
+            lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
+            rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
         if lt.validity is not None:
             lvalid = lvalid & lt.validity
         if rt.validity is not None:
             rvalid = rvalid & rt.validity
-    return lcomb, lvalid, rcomb, rvalid
+    if hashed:
+        lcomb, rcomb = P._hash_keys([p[0] for p in prepped],
+                                    [p[1] for p in prepped])
+    return lcomb, lvalid, rcomb, rvalid, prepped
 
 
 @dataclass(eq=False)
@@ -581,7 +593,7 @@ class JoinCountExec(P.PhysicalPlan):
         lpipe, rpipe = child_pipes
         if self.broadcast:
             rpipe = X.broadcast_gather(rpipe)
-        lkey, lvalid, rkey, rvalid = packed_join_keys(
+        lkey, lvalid, rkey, rvalid, _ = packed_join_keys(
             lpipe, rpipe, self.left_keys, self.right_keys,
             self.mins, self.ranges)
         rng = K.build_join_ranges(rkey, rpipe.mask & rvalid,
@@ -635,13 +647,15 @@ class JoinApplyExec(P.PhysicalPlan):
         if how == "cross":
             return self._cross(lpipe, rpipe)
 
-        lkey, lvalid, rkey, rvalid = packed_join_keys(
+        lkey, lvalid, rkey, rvalid, prepped = packed_join_keys(
             lpipe, rpipe, self.left_keys, self.right_keys,
             self.mins, self.ranges)
+        hashed = self.mins is None
         ranges = K.build_join_ranges(rkey, rpipe.mask & rvalid,
                                      lkey, lpipe.mask & lvalid)
 
-        if how in ("left_semi", "left_anti") and self.condition is None:
+        if how in ("left_semi", "left_anti") and self.condition is None \
+                and not hashed:
             has_match = ranges.counts > 0
             keep = lpipe.mask & (has_match if how == "left_semi"
                                  else ~has_match)
@@ -649,6 +663,9 @@ class JoinApplyExec(P.PhysicalPlan):
 
         cap = self.pair_capacity
         p_idx, b_idx, pair_mask = K.expand_join_pairs(ranges, cap)
+        if hashed:
+            pair_mask = pair_mask & P._verify_key_pairs(
+                prepped, p_idx, b_idx, cap)
 
         # pair env always carries BOTH sides so semi/anti conditions can
         # reference the inner relation (names match Join.schema dedup)

@@ -819,6 +819,27 @@ class HashAggregateExec(PhysicalPlan):
 # ---- join ------------------------------------------------------------------
 
 
+def _hash_keys(lds, rds):
+    """Hash-combine multiple key columns into one int64 per row. Shifted
+    right by 2 so the max value is 2^62-1 — strictly below the int64
+    sentinel build_join_ranges uses for dead rows."""
+    lh = K.hash64(lds[0])
+    rh = K.hash64(rds[0])
+    for ld, rd in zip(lds[1:], rds[1:]):
+        lh = K.hash_combine(lh, ld)
+        rh = K.hash_combine(rh, rd)
+    return ((lh >> jnp.uint64(2)).astype(jnp.int64),
+            (rh >> jnp.uint64(2)).astype(jnp.int64))
+
+
+def _verify_key_pairs(prepped, p_idx, b_idx, cap):
+    """Exact key equality for hash-matched pairs."""
+    ok = jnp.ones((cap,), dtype=jnp.bool_)
+    for ld, rd in prepped:
+        ok = ok & (ld[p_idx] == rd[b_idx])
+    return ok
+
+
 def _pair_names(left_names, right_names) -> List[str]:
     """Joined-pair column names (delegates to the canonical dedup)."""
     return E.dedup_pair_names(left_names, right_names)
@@ -991,6 +1012,7 @@ class JoinExec(PhysicalPlan):
 
         total_range = 1
         packing: List[Tuple[int, int]] = []
+        overflow = False
         for ld, rd, rg, si in prepped:
             mn = 0
             if rg is None:
@@ -998,19 +1020,30 @@ class JoinExec(PhysicalPlan):
                 if mn > mx:
                     mn, mx = 0, 0
                 rg = mx - mn + 1
-            if total_range > 1 and total_range * rg > (1 << 62):
-                raise NotImplementedError(
-                    "multi-key join exceeds int64 packing range")
+            if total_range * rg > (1 << 62):  # incl. single wide key
+                overflow = True
+                break
             lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
             rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
             total_range *= rg
             packing.append((mn, rg))
+        if overflow:
+            # exact range packing impossible (e.g. two hash-like int64
+            # ids): hash-combine the keys and VERIFY pairs after
+            # expansion (reference: HashedRelation.scala:208 — probe by
+            # hash, confirm by key equality)
+            lcomb, rcomb = _hash_keys([p[0] for p in prepped],
+                                      [p[1] for p in prepped])
+            packing = "hash"  # type: ignore[assignment]
         for lt, rt in zip(lks, rks):
             if lt.validity is not None:
                 lvalid = lvalid & lt.validity
             if rt.validity is not None:
                 rvalid = rvalid & rt.validity
-        return lcomb, lvalid, rcomb, rvalid, tuple(packing)
+        if packing != "hash":
+            packing = tuple(packing)
+        return lcomb, lvalid, rcomb, rvalid, packing, \
+            [(p[0], p[1]) for p in prepped]
 
     # -- traced path (adaptive, unique-build) ---------------------------------
 
@@ -1018,7 +1051,8 @@ class JoinExec(PhysicalPlan):
         """Key packing with STATIC per-key (mn, rg) from adaptive stats —
         no host syncs, so the join fuses into the surrounding program.
         Sound because the planner only binds stats recorded for these
-        exact (immutable) leaf arrays."""
+        exact (immutable) leaf arrays. packing == 'hash' reproduces the
+        hash-combined fallback; callers must then verify pairs."""
         lenv, renv = lpipe.env(), rpipe.env()
         lks = [C.evaluate(k, lenv) for k in self.left_keys]
         rks = [C.evaluate(k, renv) for k in self.right_keys]
@@ -1026,7 +1060,10 @@ class JoinExec(PhysicalPlan):
         rcomb = jnp.zeros((rpipe.capacity,), dtype=jnp.int64)
         lvalid = jnp.ones((lpipe.capacity,), dtype=jnp.bool_)
         rvalid = jnp.ones((rpipe.capacity,), dtype=jnp.bool_)
-        for (lt, rt), (mn, rg) in zip(zip(lks, rks), self.adaptive[0]):
+        packing = self.adaptive[0]
+        hashed = packing == "hash"
+        prepped = []
+        for ki, (lt, rt) in enumerate(zip(lks, rks)):
             if isinstance(lt.dtype, T.StringType) \
                     or isinstance(rt.dtype, T.StringType):
                 union, (tl, tr) = C.unify_dictionaries(
@@ -1035,17 +1072,27 @@ class JoinExec(PhysicalPlan):
                       if len(lt.dictionary or ()) else lt.data)
                 rd = (jnp.asarray(tr)[rt.data]
                       if len(rt.dictionary or ()) else rt.data)
-                mn, rg = 0, max(rg, len(union))
+                mn, rg = 0, max(1, len(union))
             else:
                 ld = lt.data.astype(jnp.int64)
                 rd = rt.data.astype(jnp.int64)
-            lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
-            rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
+                if not hashed:
+                    mn, rg = packing[ki]
+            prepped.append((ld, rd))
+            if not hashed:
+                if isinstance(lt.dtype, T.StringType) \
+                        or isinstance(rt.dtype, T.StringType):
+                    rg = max(rg, packing[ki][1])
+                lcomb = lcomb * rg + jnp.clip(ld - mn, 0, rg - 1)
+                rcomb = rcomb * rg + jnp.clip(rd - mn, 0, rg - 1)
             if lt.validity is not None:
                 lvalid = lvalid & lt.validity
             if rt.validity is not None:
                 rvalid = rvalid & rt.validity
-        return lcomb, lvalid, rcomb, rvalid
+        if hashed:
+            lcomb, rcomb = _hash_keys([p[0] for p in prepped],
+                                      [p[1] for p in prepped])
+        return lcomb, lvalid, rcomb, rvalid, hashed, prepped
 
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
         """Unique-build join as a pure gather: each probe row has at most
@@ -1054,17 +1101,22 @@ class JoinExec(PhysicalPlan):
         fast path every TPC-H join takes after the first execution."""
         lpipe, rpipe = child_pipes
         _, unique_build, unique_probe = self.adaptive
-        lcomb, lvalid, rcomb, rvalid = self._traced_keys(lpipe, rpipe)
+        lcomb, lvalid, rcomb, rvalid, hashed, prepped = self._traced_keys(
+            lpipe, rpipe)
         if not unique_build:
             # inner join with unique LEFT side: swap roles — left becomes
             # the build, output rows ride at right capacity
             return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
-                                       rcomb, rvalid)
+                                       rcomb, rvalid, hashed, prepped)
         ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
                                      lcomb, lpipe.mask & lvalid)
         has = ranges.counts > 0
         b_idx = ranges.build_perm[
             jnp.clip(ranges.lo, 0, rpipe.capacity - 1)]
+        if hashed:
+            p_idx = jnp.arange(lpipe.capacity)
+            has = has & _verify_key_pairs(prepped, p_idx, b_idx,
+                                          lpipe.capacity)
         if self.how in ("left_semi", "left_anti") and self.condition is None:
             keep = lpipe.mask & (has if self.how == "left_semi" else ~has)
             return Pipe(lpipe.cols, keep, lpipe.order)
@@ -1101,7 +1153,7 @@ class JoinExec(PhysicalPlan):
         return Pipe(cols, lpipe.mask, order)
 
     def _trace_swapped(self, lpipe: Pipe, rpipe: Pipe, lcomb, lvalid,
-                       rcomb, rvalid) -> Pipe:
+                       rcomb, rvalid, hashed=False, prepped=()) -> Pipe:
         """Inner join with a unique LEFT side: build on the left, stream
         the right; each right row gathers its single left match."""
         ranges = K.build_join_ranges(lcomb, lpipe.mask & lvalid,
@@ -1109,6 +1161,12 @@ class JoinExec(PhysicalPlan):
         has = ranges.counts > 0
         l_idx = ranges.build_perm[
             jnp.clip(ranges.lo, 0, lpipe.capacity - 1)]
+        if hashed:
+            # verify with sides swapped: left is the build being gathered
+            swapped = [(rd, ld) for ld, rd in prepped]
+            has = has & _verify_key_pairs(
+                swapped, jnp.arange(rpipe.capacity), l_idx,
+                rpipe.capacity)
         pair_names = _pair_names(lpipe.order, rpipe.order)
         n_l = len(lpipe.order)
         cols: Dict[str, TV] = {}
@@ -1145,8 +1203,9 @@ class JoinExec(PhysicalPlan):
             # plans used to OOM/hang here)
             return self._nested_loop(lpipe, rpipe, how)
 
-        lkey, lvalid, rkey, rvalid, packing = self._combined_keys(
+        lkey, lvalid, rkey, rvalid, packing, prepped = self._combined_keys(
             lpipe, rpipe)
+        hashed = packing == "hash"
         # probe = left, build = right (left-side row order is preserved,
         # matching streamed-side semantics)
         ranges = K.build_join_ranges(rkey, rpipe.mask & rvalid,
@@ -1156,7 +1215,8 @@ class JoinExec(PhysicalPlan):
         sk = self.stats_key() if adaptive_how else None
         record = adaptive_how and sk not in _JOIN_STATS
 
-        if how in ("left_semi", "left_anti") and self.condition is None:
+        if how in ("left_semi", "left_anti") and self.condition is None \
+                and not hashed:
             if record:
                 maxc = int(jax.device_get(ranges.counts.max()))
                 _JOIN_STATS.put(sk, (packing, maxc <= 1, False))
@@ -1207,6 +1267,10 @@ class JoinExec(PhysicalPlan):
             order.append(out_name)
 
         pair_ok = pair_mask
+        if hashed:
+            # hash probe: confirm candidate pairs by exact key equality
+            pair_ok = pair_ok & _verify_key_pairs(prepped, p_idx, b_idx,
+                                                  cap)
         if self.condition is not None:
             env = Env(cols, cap)
             ctv = C.evaluate(self.condition, env)

@@ -159,6 +159,9 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
     key = (plan.plan_key(), _adaptive_snapshot(plan))
     entry = _STAGE_CACHE.get(key)
     if entry is None:
+        from spark_tpu import metrics
+
+        metrics.record("stage_compile", node=plan.node_string())
         schema_box: dict = {}
         skeleton = _strip_leaf_data(plan)
 
@@ -211,15 +214,20 @@ def execute(plan: P.PhysicalPlan) -> Batch:
 
 
 def _execute(plan: P.PhysicalPlan) -> Batch:
+    from spark_tpu import metrics
+
     if isinstance(plan, P.BatchScanExec):
         return plan.batch
     if _fully_traceable(plan):
-        return _run_fused(plan)
+        with metrics.stage_timer("fused", node=plan.node_string()):
+            return _run_fused(plan)
     child_batches = []
     for c in plan.children():
         b = _execute(c)
         child_batches.append(_maybe_compact(b))
-    return plan.execute_blocking(child_batches)
+    with metrics.stage_timer("blocking", node=plan.node_string(),
+                             cap_in=[b.capacity for b in child_batches]):
+        return plan.execute_blocking(child_batches)
 
 
 def execute_logical(plan: L.LogicalPlan, optimize: bool = True) -> Batch:

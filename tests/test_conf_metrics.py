@@ -1,0 +1,83 @@
+"""Config registry wiring + per-stage metrics/event log (reference:
+SQLConf autoBroadcastJoinThreshold, SQLMetrics.scala:40,
+EventLoggingListener.scala:48)."""
+
+import json
+import os
+
+from spark_tpu import metrics
+from spark_tpu.conf import RuntimeConf
+
+
+def _mesh_executor(conf=None):
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+
+    return MeshExecutor(make_mesh(4), conf=conf)
+
+
+def _join_plan(spark):
+    left = spark.createDataFrame(
+        [{"k": i % 8, "v": i} for i in range(64)])
+    right = spark.createDataFrame(
+        [{"k": i, "w": i * 10} for i in range(8)])
+    from spark_tpu.plan import logical as L
+    from spark_tpu.expr import expressions as E
+
+    return L.Join(left._plan, right._plan, "inner",
+                  (E.Col("k"),), (E.Col("k"),))
+
+
+def test_broadcast_threshold_zero_forces_partitioned(spark, monkeypatch):
+    import spark_tpu.parallel.executor as X
+
+    plan = _join_plan(spark)
+
+    conf0 = RuntimeConf({"spark.sql.autoBroadcastJoinThreshold": 0})
+    ex0 = _mesh_executor(conf0)
+    orig_run = X.MeshExecutor.run
+    exch_seen = []
+
+    def run_spy(self, p):
+        from spark_tpu.parallel import operators as D
+
+        if isinstance(p, D.HashPartitionExchangeExec):
+            exch_seen.append(True)
+        return orig_run(self, p)
+
+    monkeypatch.setattr(X.MeshExecutor, "run", run_spy)
+    rows = ex0.execute_logical(plan).to_pylist()
+    assert len(rows) == 64
+    assert exch_seen, "threshold=0 must force a partitioned (exchange) join"
+
+    exch_seen.clear()
+    conf_big = RuntimeConf(
+        {"spark.sql.autoBroadcastJoinThreshold": 1 << 30})
+    ex1 = _mesh_executor(conf_big)
+    rows = ex1.execute_logical(plan).to_pylist()
+    assert len(rows) == 64
+    assert not exch_seen, "huge threshold must broadcast the tiny build"
+
+
+def test_stage_events_recorded(spark):
+    metrics.reset()
+    df = spark.createDataFrame([{"x": i} for i in range(10)])
+    df.groupBy((df.x % 3).alias("g")).count().collect()
+    evs = metrics.last_query()
+    kinds = {e["kind"] for e in evs}
+    assert "query_start" in kinds and "stage" in kinds, kinds
+    stage = [e for e in evs if e["kind"] == "stage"]
+    assert all("ms" in e for e in stage)
+
+
+def test_event_log_jsonl(spark, tmp_path):
+    spark.conf.set("spark.eventLog.dir", str(tmp_path))
+    try:
+        df = spark.createDataFrame([{"x": 1}, {"x": 2}])
+        assert df.count() == 2
+        path = os.path.join(str(tmp_path), "events.jsonl")
+        assert os.path.exists(path)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert any(e["kind"] == "stage" for e in lines)
+    finally:
+        spark.conf.unset("spark.eventLog.dir")

@@ -42,9 +42,9 @@ def test_query_parity_single_device(tpch, qnum):
     assert_rows_match(got, want, label=f"q{qnum}")
 
 
-@pytest.mark.parametrize("qnum", [1, 3, 4, 5, 6, 10, 12, 14, 16, 18, 19])
+@pytest.mark.parametrize("qnum", ALL_QUERIES)
 def test_query_parity_mesh(tpch, qnum):
-    """Distributed runs of the shuffle-heavy subset vs the same oracle."""
+    """Distributed runs of ALL 22 queries vs the same oracle."""
     from spark_tpu.parallel.executor import MeshExecutor
     from spark_tpu.parallel.mesh import make_mesh
     from spark_tpu.sql.parser import parse_sql
