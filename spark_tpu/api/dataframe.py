@@ -284,6 +284,23 @@ class DataFrame:
         batch = self._execute()
         return [Row.from_dict(d) for d in batch.to_pylist()]
 
+    @property
+    def isStreaming(self) -> bool:
+        from spark_tpu.streaming.execution import StreamingSource
+
+        return bool(L.collect_nodes(self._plan, StreamingSource))
+
+    @property
+    def writeStream(self):
+        from spark_tpu.streaming.readwriter import DataStreamWriter
+
+        return DataStreamWriter(self)
+
+    def withWatermark(self, col_name: str, delay) -> "DataFrame":
+        from spark_tpu.streaming.readwriter import with_watermark
+
+        return with_watermark(self, col_name, delay)
+
     def toPandas(self):
         return self._execute().to_pandas()
 

@@ -39,6 +39,12 @@ def expr(sql_text: str) -> Column:
     return parse_expression(sql_text)
 
 
+def window(c: ColumnOrName, width: int) -> Column:
+    """Tumbling event-time window of ``width`` time units; the produced
+    column is the window START (reference: functions.window)."""
+    return E.TumblingWindow(_c(c), int(width))
+
+
 # ---- window functions ------------------------------------------------------
 
 

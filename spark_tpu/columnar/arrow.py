@@ -149,6 +149,14 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> Batch:
     return from_numpy(schema, arrays, validities, capacity=capacity)
 
 
+def schema_from_arrow(pa_schema: "pa.Schema") -> Schema:
+    """Engine Schema for an arrow schema (via an empty conversion so the
+    type mapping stays in one place)."""
+    empty = pa.table({f.name: pa.array([], type=f.type)
+                      for f in pa_schema})
+    return from_arrow(empty).schema
+
+
 def to_arrow(batch: Batch) -> pa.Table:
     """Device Batch -> Arrow table with only live rows."""
     mask = np.asarray(batch.data.row_mask)

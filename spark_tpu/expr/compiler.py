@@ -148,6 +148,10 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
     if isinstance(expr, E.Alias):
         return evaluate(expr.child, env)
 
+    if isinstance(expr, E.TumblingWindow):
+        # batch evaluation: window start = child - child % width
+        return evaluate(expr.as_arith(), env)
+
     if isinstance(expr, E.Neg):
         tv = evaluate(expr.child, env)
         return TV(-tv.data, tv.validity, tv.dtype, None)

@@ -696,6 +696,34 @@ class WindowExpr(Expression):
         return self.name
 
 
+@dataclass(eq=False, frozen=True)
+class TumblingWindow(Expression):
+    """Tumbling event-time window key: floor(child / width) * width, the
+    window START (reference: expressions/TimeWindow.scala). Carrying the
+    width lets streaming eviction close a window only when the watermark
+    passes its END."""
+
+    child: Expression
+    width: int
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        return "window"
+
+    def __str__(self):
+        return f"window({self.child}, {self.width})"
+
+    def as_arith(self) -> Expression:
+        return Arith("-", self.child,
+                     Arith("%", self.child, Literal(self.width)))
+
+
 def window_dictionary(w: "WindowExpr", schema) -> Optional[tuple]:
     """String dictionary of a window output, when the function carries
     values through from a dictionary-encoded column (lag/lead/min/max/
@@ -1002,6 +1030,39 @@ def collect_aggregates(e: Expression) -> list:
     for c in e.children():
         out.extend(collect_aggregates(c))
     return out
+
+
+def transform_expr_down(e: Expression, fn) -> Expression:
+    """PRE-order expression transform: ``fn`` sees each node before its
+    children; when fn returns a replacement, recursion stops there
+    (TreeNode.transformDown analogue)."""
+    import dataclasses
+
+    ne = fn(e)
+    if ne is not e:
+        return ne
+    new_fields = {}
+    changed = False
+    for f_name, f_val in vars(e).items():
+        if isinstance(f_val, Expression):
+            nv = transform_expr_down(f_val, fn)
+            changed |= nv is not f_val
+            new_fields[f_name] = nv
+        elif isinstance(f_val, tuple) and any(
+                isinstance(x, Expression) for x in f_val):
+            nlist = tuple(
+                transform_expr_down(x, fn) if isinstance(x, Expression)
+                else x for x in f_val)
+            changed |= any(a is not b for a, b in zip(nlist, f_val))
+            new_fields[f_name] = nlist
+        else:
+            new_fields[f_name] = f_val
+    if changed:
+        e = dataclasses.replace(e, **{
+            k: v for k, v in new_fields.items()
+            if k in {fl.name for fl in dataclasses.fields(e)}
+        })
+    return e
 
 
 def transform_expr(e: Expression, fn) -> Expression:

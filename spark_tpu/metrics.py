@@ -21,6 +21,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 _LOCK = threading.Lock()
+_IO_LOCK = threading.Lock()
 _EVENTS: deque = deque(maxlen=4096)
 _QUERY_MARKS: deque = deque(maxlen=64)
 _counter = 0
@@ -56,7 +57,10 @@ def record(kind: str, **fields: Any) -> None:
     with _LOCK:
         _counter += 1
         _EVENTS.append(ev)
-        if path is not None:
+    if path is not None:
+        # separate IO lock: disk latency must not serialize stages that
+        # only touch the in-memory ring
+        with _IO_LOCK:
             with open(path, "a") as f:
                 f.write(json.dumps(ev) + "\n")
 

@@ -285,6 +285,20 @@ class Window(LogicalPlan):
         return f"Window[{', '.join(str(e) for e in self.window_exprs)}]"
 
 
+def collect_nodes(plan: "LogicalPlan", cls) -> list:
+    """All nodes of type ``cls`` in the tree (pre-order)."""
+    out: list = []
+
+    def go(p):
+        if isinstance(p, cls):
+            out.append(p)
+        for c in p.children():
+            go(c)
+
+    go(plan)
+    return out
+
+
 def project_with_windows(exprs: Tuple[E.Expression, ...],
                          child: LogicalPlan) -> LogicalPlan:
     """Build Project(exprs, child), hoisting any WindowExpr into a

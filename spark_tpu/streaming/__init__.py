@@ -1,0 +1,25 @@
+"""Structured streaming: micro-batch incremental execution.
+
+The reference's streaming engine (reference:
+sql/core/.../execution/streaming/MicroBatchExecution.scala:41,
+StreamExecution.scala, IncrementalExecution.scala:43) incrementalizes a
+DataFrame query: each trigger reads new source offsets, splices the new
+rows into the logical plan, and runs an ordinary batch execution whose
+stateful operators read/write a versioned state store checkpointed with
+a write-ahead offset log.
+
+This package keeps that exact architecture — streaming rides entirely on
+the batch engine (and therefore on the TPU mesh): per micro-batch the
+new rows' PARTIAL aggregates are computed by the normal engine, merged
+with the persisted state by a second normal aggregation over their
+union, and committed as the next state version. Sources, sinks, state
+store, watermark and checkpoint live here; no operator code is
+duplicated.
+"""
+
+from spark_tpu.streaming.sources import MemoryStream, RateStreamSource
+from spark_tpu.streaming.state import StateStore
+from spark_tpu.streaming.execution import StreamingQuery, StreamingSource
+
+__all__ = ["MemoryStream", "RateStreamSource", "StateStore",
+           "StreamingQuery", "StreamingSource"]

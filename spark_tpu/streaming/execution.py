@@ -1,0 +1,357 @@
+"""Micro-batch incremental execution (reference:
+sql/core/.../execution/streaming/MicroBatchExecution.scala:41
+runActivatedStream:234 constructNextBatch:475 runBatch:579, plus
+IncrementalExecution.scala:43 and WatermarkTracker.scala).
+
+Each trigger: log new source offsets to the WAL, splice the new rows
+into the logical plan, run ORDINARY batch executions to (a) compute the
+new rows' partial aggregates and (b) merge them with the previous state
+version over a union — both of which run on whatever engine the session
+uses, including the TPU mesh — then commit state + offsets. Aggregates
+are incrementalized by accumulator decomposition (sum/count/min/max are
+mergeable; avg = sum+count), the same partial/final split the batch
+planner uses for distributed aggregation."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+from spark_tpu.streaming.state import OffsetLog, StateStore
+
+_qids = itertools.count()
+
+
+@dataclass(eq=False, frozen=True)
+class StreamingSource(L.LogicalPlan):
+    """Leaf marker for a streaming source; replaced per micro-batch by a
+    Relation over the new rows (reference: StreamingExecutionRelation)."""
+
+    source: object  # MemoryStream / RateStreamSource
+    watermark_col: Optional[str] = None
+    watermark_delay: int = 0  # same units as the event-time column
+
+    @property
+    def schema(self):
+        return self.source.schema
+
+    def node_string(self):
+        return f"StreamingSource[{getattr(self.source, 'name', '?')}]"
+
+
+def _find_source(plan: L.LogicalPlan) -> StreamingSource:
+    found = L.collect_nodes(plan, StreamingSource)
+    if len(found) != 1:
+        raise NotImplementedError(
+            f"exactly one streaming source supported, got {len(found)}")
+    return found[0]
+
+
+def _splice(plan: L.LogicalPlan, replacement: L.LogicalPlan):
+    def fn(p):
+        if isinstance(p, StreamingSource):
+            return replacement
+        return p
+
+    return plan.transform_up(fn)
+
+
+class _AggSpec:
+    """Accumulator decomposition of one streaming Aggregate node."""
+
+    def __init__(self, agg: L.Aggregate):
+        self.groupings = [E.strip_alias(g) for g in agg.groupings]
+        #: tumbling-window widths per grouping (None = not a window key);
+        #: the engine executes the window as plain arithmetic, the width
+        #: only matters for watermark eviction
+        self.window_widths = [
+            g.width if isinstance(g, E.TumblingWindow) else None
+            for g in self.groupings]
+        self.groupings_exec = [
+            g.as_arith() if isinstance(g, E.TumblingWindow) else g
+            for g in self.groupings]
+        self.key_names = [f"__k{i}" for i in range(len(self.groupings))]
+        self.partials: List[E.Alias] = []   # over input rows
+        self.merges: List[E.Alias] = []     # over union(state, partials)
+        self._final: Dict[tuple, E.Expression] = {}
+        for call in {E.expr_key(a): a
+                     for e in agg.aggregates
+                     for a in E.collect_aggregates(e)}.values():
+            self._add(call)
+        self.outputs: List[E.Alias] = []
+        key_map = {E.expr_key(g): E.Col(n)
+                   for g, n in zip(self.groupings, self.key_names)}
+
+        def repl(x: E.Expression) -> E.Expression:
+            # pre-order: an aggregate call is replaced wholesale BEFORE
+            # its children could be rewritten (count(k) grouped by k)
+            if isinstance(x, E.AggregateExpression):
+                return self._final[E.expr_key(x)]
+            k = E.expr_key(x)
+            if k in key_map:
+                return key_map[k]
+            return x
+
+        for e in agg.aggregates:
+            out = E.transform_expr_down(E.strip_alias(e), repl)
+            self.outputs.append(E.Alias(out, e.name))
+
+    def _acc(self, name: str, partial: E.Expression,
+             merge: E.Expression) -> None:
+        self.partials.append(E.Alias(partial, name))
+        self.merges.append(E.Alias(merge, name))
+
+    def _add(self, call: E.AggregateExpression) -> None:
+        if getattr(call, "distinct", False):
+            raise NotImplementedError(
+                "DISTINCT aggregates in streaming queries")
+        i = len(self.partials)
+        k = E.expr_key(call)
+        if isinstance(call, E.Count):
+            n = f"__a{i}"
+            self._acc(n, call, E.Sum(E.Col(n)))
+            self._final[k] = E.Coalesce((E.Col(n), E.Literal(0)))
+        elif isinstance(call, (E.Sum, E.Avg)):
+            s, c = f"__a{i}s", f"__a{i}n"
+            self._acc(s, E.Sum(call.child), E.Sum(E.Col(s)))
+            self._acc(c, E.Count(call.child), E.Sum(E.Col(c)))
+            nonzero = E.Cmp(">", E.Coalesce((E.Col(c), E.Literal(0))),
+                            E.Literal(0))
+            if isinstance(call, E.Sum):
+                self._final[k] = E.Case(((nonzero, E.Col(s)),), None)
+            else:
+                self._final[k] = E.Case(
+                    ((nonzero, E.Arith("/", E.Col(s), E.Col(c))),), None)
+        elif isinstance(call, (E.Min, E.Max)):
+            n = f"__a{i}"
+            cls = E.Min if isinstance(call, E.Min) else E.Max
+            self._acc(n, call, cls(E.Col(n)))
+            self._final[k] = E.Col(n)
+        else:
+            raise NotImplementedError(
+                f"streaming aggregate {call} is not mergeable here")
+
+
+class StreamingQuery:
+    """One running (manually or loop-triggered) streaming query
+    (reference: StreamExecution + StreamingQuery)."""
+
+    def __init__(self, session, plan: L.LogicalPlan, sink_name: str,
+                 output_mode: str = "complete",
+                 checkpoint_dir: Optional[str] = None):
+        self._session = session
+        self._plan = plan
+        self.name = sink_name or f"stream{next(_qids)}"
+        self.output_mode = output_mode
+        self._src_node = _find_source(plan)
+        self._source = self._src_node.source
+        self._log = OffsetLog(checkpoint_dir)
+        self._store = StateStore(checkpoint_dir)
+        self._batch_id = self._log.last_committed
+        self._appended: List[pa.Table] = []
+        #: restored from the commit log so the watermark survives restart
+        self._max_event_time: Optional[int] = self._log.last_watermark()
+        self._agg, self._above, self._below = self._split_plan()
+        if self._agg is not None and output_mode == "update":
+            raise NotImplementedError(
+                "outputMode('update') with aggregation: use 'complete' "
+                "or 'append' (with a watermark)")
+        self._register_sink()
+        self.is_active = True
+
+    # -- plan surgery ---------------------------------------------------------
+
+    def _split_plan(self):
+        """Locate the (single) streaming Aggregate: returns
+        (spec_or_None, nodes-above builder, child-subtree-below)."""
+        aggs = L.collect_nodes(self._plan, L.Aggregate)
+        if not aggs:
+            return None, None, None
+        if len(aggs) > 1:
+            raise NotImplementedError(
+                "multiple aggregations in one streaming query")
+        agg = aggs[0]
+        return _AggSpec(agg), agg, agg.child
+
+    # -- execution ------------------------------------------------------------
+
+    def _run(self, plan: L.LogicalPlan):
+        ex = getattr(self._session, "mesh_executor", None)
+        if ex is not None:
+            return ex.execute_logical(plan)
+        from spark_tpu.physical.planner import execute_logical
+
+        return execute_logical(plan)
+
+    def _to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
+        from spark_tpu.columnar.arrow import to_arrow
+
+        return to_arrow(self._run(plan))
+
+    def process_all_available(self) -> None:
+        """Drain the source (Trigger.AvailableNow analogue)."""
+        while True:
+            latest = self._source.latest_offset()
+            batch_id = self._batch_id + 1
+            logged = self._log.offsets_for(batch_id)
+            if logged is not None:
+                start, end = logged["start"], logged["end"]
+            else:
+                prev = self._log.offsets_for(self._batch_id)
+                start = prev["end"] if prev else 0
+                end = latest
+                if end <= start:
+                    return
+                self._log.log_offsets(batch_id, {"start": start,
+                                                 "end": end})
+            self._run_batch(batch_id, start, end)
+
+    processAllAvailable = process_all_available
+
+    def _run_batch(self, batch_id: int, start: int, end: int) -> None:
+        from spark_tpu.columnar.arrow import from_arrow
+
+        new_rows = self._source.get_batch(start, end)
+        wm_col = self._src_node.watermark_col
+        wm_before = self._watermark()
+        if wm_col is not None and wm_before is not None \
+                and new_rows.num_rows > 0 \
+                and wm_col in new_rows.column_names:
+            # rows older than the watermark are LATE and dropped before
+            # any state update (reference: EventTimeWatermark filter) —
+            # otherwise an already-emitted window could re-open
+            import pyarrow.compute as pc
+
+            new_rows = new_rows.filter(
+                pc.greater_equal(new_rows.column(wm_col),
+                                 pa.scalar(wm_before)))
+        rel = L.Relation(from_arrow(new_rows))
+
+        if self._agg is None:
+            out = self._to_arrow(_splice(self._plan, rel))
+            self._appended.append(out)
+            self._store.commit(batch_id, pa.table({}))
+            self._log.commit(batch_id)
+            self._batch_id = batch_id
+            self._register_sink()
+            return
+
+        spec = self._agg
+        batch_child = _splice(self._below, rel)
+        key_aliases = tuple(E.Alias(g, n) for g, n
+                            in zip(spec.groupings_exec, spec.key_names))
+        partial = L.Aggregate(
+            tuple(spec.groupings_exec),
+            key_aliases + tuple(spec.partials), batch_child)
+        partial_tbl = self._to_arrow(partial)
+
+        prev = self._store.get(self._batch_id)
+        if prev is not None and prev.num_rows > 0:
+            merged_in = pa.concat_tables(
+                [prev, partial_tbl.select(prev.column_names)])
+        else:
+            merged_in = partial_tbl
+        mrel = L.Relation(from_arrow(merged_in))
+        keys = tuple(E.Col(n) for n in spec.key_names)
+        merged = L.Aggregate(
+            keys, tuple(E.Alias(E.Col(n), n) for n in spec.key_names)
+            + tuple(spec.merges), mrel)
+        state_tbl = self._to_arrow(merged)
+
+        # watermark: track max event time from the new rows
+        emitted: Optional[pa.Table] = None
+        if wm_col is not None and new_rows.num_rows > 0 \
+                and wm_col in new_rows.column_names:
+            mx = pa.compute.max(new_rows.column(wm_col)).as_py()
+            mx = int(mx) if mx is not None else None
+            if mx is not None:
+                if self._max_event_time is None \
+                        or mx > self._max_event_time:
+                    self._max_event_time = mx
+        if self.output_mode == "append":
+            state_tbl, emitted = self._evict_closed(state_tbl)
+
+        self._store.commit(batch_id, state_tbl)
+        self._log.commit(batch_id, watermark=self._max_event_time)
+        self._batch_id = batch_id
+        if emitted is not None and emitted.num_rows > 0:
+            self._appended.append(self._finalize(emitted))
+        self._register_sink()
+
+    def _watermark(self) -> Optional[int]:
+        if self._max_event_time is None:
+            return None
+        return self._max_event_time - self._src_node.watermark_delay
+
+    def _evict_closed(self, state: pa.Table):
+        """Append mode: groups whose event-time key is entirely below the
+        watermark can never change — emit and drop them (reference:
+        statefulOperators.scala StateStoreSaveExec append mode)."""
+        wm = self._watermark()
+        if wm is None or state.num_rows == 0:
+            return state, None
+        spec = self._agg
+        # the event-time grouping is the key referencing the wm column
+        idx = None
+        for i, g in enumerate(spec.groupings):
+            if self._src_node.watermark_col in g.references():
+                idx = i
+                break
+        if idx is None:
+            return state, None
+        import pyarrow.compute as pc
+
+        key = state.column(spec.key_names[idx])
+        width = spec.window_widths[idx]
+        if width is not None:
+            # a window [start, start+width) closes when the watermark
+            # passes its END
+            closed = pc.less_equal(pc.add(key, pa.scalar(width)),
+                                   pa.scalar(wm))
+        else:
+            closed = pc.less(key, pa.scalar(wm))
+        return state.filter(pc.invert(closed)), state.filter(closed)
+
+    def _finalize(self, state_tbl: pa.Table) -> pa.Table:
+        from spark_tpu.columnar.arrow import from_arrow
+
+        spec = self._agg
+        out = L.Project(tuple(spec.outputs), L.Relation(
+            from_arrow(state_tbl)))
+        return self._to_arrow(out)
+
+    # -- sink -----------------------------------------------------------------
+
+    def _current_result(self) -> pa.Table:
+        if self._agg is None or self.output_mode == "append":
+            if self._appended:
+                return pa.concat_tables(self._appended)
+            # empty table with the right schema
+            state = self._store.get(self._batch_id)
+            if self._agg is not None and state is not None:
+                return self._finalize(state.slice(0, 0))
+            return pa.table({})
+        state = self._store.get(self._batch_id)
+        if state is None or state.num_rows == 0:
+            return pa.table({})
+        return self._finalize(state)
+
+    def _register_sink(self) -> None:
+        """Memory sink: results queryable as a temp view (reference:
+        memory.scala MemorySink + CreateViewCommand)."""
+        from spark_tpu.columnar.arrow import from_arrow
+
+        tbl = self._current_result()
+        if tbl.num_columns == 0:
+            return
+        self._session.catalog._register_view(
+            self.name, L.Relation(from_arrow(tbl)))
+
+    def stop(self) -> None:
+        self.is_active = False

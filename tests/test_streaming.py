@@ -1,0 +1,214 @@
+"""Structured streaming: micro-batch incremental aggregation, state
+checkpoints, watermarked append mode (reference test model:
+sql/core/src/test/.../streaming/StreamTest.scala:342 AddData/CheckAnswer
+over MemoryStream)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu.api import functions as F
+from spark_tpu.streaming import MemoryStream
+
+
+def _counts(spark, name):
+    rows = spark.sql(f"select * from {name}").collect()
+    return {tuple(r.values())[0]: tuple(r.values())[1:] for r in
+            (r.asDict() for r in rows)}
+
+
+def test_incremental_grouped_count(spark):
+    src = MemoryStream(pa.schema([("k", pa.string()), ("v", pa.int64())]))
+    df = spark.readStream.load(src)
+    agg = df.groupBy("k").agg(F.count("v").alias("n"),
+                              F.sum("v").alias("s"))
+    q = agg.writeStream.outputMode("complete").queryName("cnt1").start()
+
+    src.add_data([{"k": "a", "v": 1}, {"k": "b", "v": 2}])
+    q.process_all_available()
+    assert _counts(spark, "cnt1") == {"a": (1, 1), "b": (1, 2)}
+
+    src.add_data([{"k": "a", "v": 10}])
+    q.process_all_available()
+    assert _counts(spark, "cnt1") == {"a": (2, 11), "b": (1, 2)}
+
+    # no new data: no state change
+    q.process_all_available()
+    assert _counts(spark, "cnt1") == {"a": (2, 11), "b": (1, 2)}
+
+
+def test_incremental_avg_min_max(spark):
+    src = MemoryStream(pa.schema([("k", pa.string()), ("v", pa.int64())]))
+    df = spark.readStream.load(src)
+    agg = df.groupBy("k").agg(F.avg("v").alias("a"),
+                              F.min("v").alias("lo"),
+                              F.max("v").alias("hi"))
+    q = agg.writeStream.outputMode("complete").queryName("avg1").start()
+    src.add_data([{"k": "x", "v": 10}, {"k": "x", "v": 20}])
+    q.process_all_available()
+    src.add_data([{"k": "x", "v": 60}, {"k": "y", "v": 5}])
+    q.process_all_available()
+    got = _counts(spark, "avg1")
+    assert got["x"] == (30.0, 10, 60)
+    assert got["y"] == (5.0, 5, 5)
+
+
+def test_stateless_append(spark):
+    src = MemoryStream(pa.schema([("v", pa.int64())]))
+    df = spark.readStream.load(src).filter(F.col("v") % 2 == 0) \
+        .select((F.col("v") * 10).alias("w"))
+    q = df.writeStream.outputMode("append").queryName("flt1").start()
+    src.add_data([{"v": i} for i in range(5)])
+    q.process_all_available()
+    src.add_data([{"v": 6}])
+    q.process_all_available()
+    rows = sorted(r.w for r in spark.sql("select * from flt1").collect())
+    assert rows == [0, 20, 40, 60]
+
+
+def test_checkpoint_restart_exactly_once(spark, tmp_path):
+    ckpt = str(tmp_path / "ck")
+    src = MemoryStream(pa.schema([("k", pa.string()), ("v", pa.int64())]))
+    df = spark.readStream.load(src)
+    agg = df.groupBy("k").agg(F.sum("v").alias("s"))
+    q = agg.writeStream.outputMode("complete").queryName("ck1") \
+        .option("checkpointLocation", ckpt).start()
+    src.add_data([{"k": "a", "v": 5}])
+    q.process_all_available()
+    src.add_data([{"k": "a", "v": 7}])
+    q.process_all_available()
+    assert _counts(spark, "ck1") == {"a": (12,)}
+    q.stop()
+
+    # restart from the checkpoint: state restored, already-processed
+    # offsets are NOT reprocessed, new data continues the totals
+    q2 = agg.writeStream.outputMode("complete").queryName("ck2") \
+        .option("checkpointLocation", ckpt).start()
+    q2.process_all_available()  # nothing new
+    assert _counts(spark, "ck2") == {"a": (12,)}
+    src.add_data([{"k": "a", "v": 1}])
+    q2.process_all_available()
+    assert _counts(spark, "ck2") == {"a": (13,)}
+
+
+def test_watermark_append_mode_evicts_closed_windows(spark):
+    src = MemoryStream(pa.schema([("ts", pa.int64()), ("v", pa.int64())]))
+    df = spark.readStream.load(src).withWatermark("ts", 10)
+    # tumbling 10-unit windows: F.window carries the width so eviction
+    # closes a window only when the watermark passes its END
+    win = F.window(F.col("ts"), 10).alias("wstart")
+    agg = df.groupBy(win).agg(F.count("v").alias("n"))
+    q = agg.writeStream.outputMode("append").queryName("wm1").start()
+
+    src.add_data([{"ts": 1, "v": 1}, {"ts": 5, "v": 1}, {"ts": 12, "v": 1}])
+    q.process_all_available()
+    # watermark = 12-10 = 2: no window closed yet
+    assert spark.sql("select * from wm1").collect() == []
+
+    src.add_data([{"ts": 25, "v": 1}])
+    q.process_all_available()
+    # watermark = 15: window [0,10) closed with 2 rows
+    got = {(r.wstart, r.n) for r in
+           spark.sql("select * from wm1").collect()}
+    assert got == {(0, 2)}
+
+    src.add_data([{"ts": 41, "v": 1}])
+    q.process_all_available()
+    # watermark = 31: windows [10,20) and [20,30) closed
+    got = {(r.wstart, r.n) for r in
+           spark.sql("select * from wm1").collect()}
+    assert got == {(0, 2), (10, 1), (20, 1)}
+
+
+def test_streaming_on_mesh(spark):
+    """The same incremental machinery runs on the distributed engine."""
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+
+    class MeshSession:
+        def __init__(self, inner):
+            self._inner = inner
+            self.catalog = inner.catalog
+            self.mesh_executor = MeshExecutor(make_mesh(4))
+
+    src = MemoryStream(pa.schema([("k", pa.int64()), ("v", pa.int64())]))
+    from spark_tpu.api.dataframe import DataFrame
+    from spark_tpu.streaming.execution import StreamingQuery, \
+        StreamingSource
+    from spark_tpu.plan import logical as L
+    from spark_tpu.expr import expressions as E
+
+    plan = L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Sum(E.Col("v")), "s")),
+        StreamingSource(src))
+    q = StreamingQuery(MeshSession(spark), plan, "mesh1", "complete")
+    src.add_data([{"k": i % 3, "v": i} for i in range(30)])
+    q.process_all_available()
+    src.add_data([{"k": 0, "v": 1000}])
+    q.process_all_available()
+    got = _counts(spark, "mesh1")
+    assert got[0] == (sum(i for i in range(30) if i % 3 == 0) + 1000,)
+    assert got[1] == (sum(i for i in range(30) if i % 3 == 1),)
+
+
+def test_rate_source_schema(spark):
+    df = spark.readStream.format("rate").option("rowsPerSecond", 5).load()
+    assert df.isStreaming
+    assert list(df._plan.schema.names) == ["timestamp", "value"]
+
+
+def test_late_rows_below_watermark_dropped(spark):
+    src = MemoryStream(pa.schema([("ts", pa.int64()), ("v", pa.int64())]))
+    df = spark.readStream.load(src).withWatermark("ts", 0)
+    agg = df.groupBy(F.window(F.col("ts"), 10).alias("w")) \
+        .agg(F.count("v").alias("n"))
+    q = agg.writeStream.outputMode("append").queryName("late1").start()
+    src.add_data([{"ts": 5, "v": 1}, {"ts": 6, "v": 1}])
+    q.process_all_available()
+    src.add_data([{"ts": 25, "v": 1}])  # wm -> 25, closes [0,10)
+    q.process_all_available()
+    src.add_data([{"ts": 6, "v": 1}])   # LATE: below wm, must be dropped
+    q.process_all_available()
+    src.add_data([{"ts": 100, "v": 1}])
+    q.process_all_available()
+    got = sorted((r.w, r.n) for r in
+                 spark.sql("select * from late1").collect())
+    assert got == [(0, 2), (20, 1)]  # window 0 emitted exactly once
+
+
+def test_watermark_survives_restart(spark, tmp_path):
+    ckpt = str(tmp_path / "wmck")
+    src = MemoryStream(pa.schema([("ts", pa.int64()), ("v", pa.int64())]))
+    df = spark.readStream.load(src).withWatermark("ts", 0)
+    agg = df.groupBy(F.window(F.col("ts"), 10).alias("w")) \
+        .agg(F.count("v").alias("n"))
+    q = agg.writeStream.outputMode("append").queryName("wr1") \
+        .option("checkpointLocation", ckpt).start()
+    src.add_data([{"ts": 5, "v": 1}, {"ts": 25, "v": 1}])
+    q.process_all_available()
+    q.stop()
+    q2 = agg.writeStream.outputMode("append").queryName("wr2") \
+        .option("checkpointLocation", ckpt).start()
+    assert q2._max_event_time == 25  # restored from the commit log
+    src.add_data([{"ts": 3, "v": 1}])  # late after restart: dropped
+    q2.process_all_available()
+    src.add_data([{"ts": 100, "v": 1}])
+    q2.process_all_available()
+    got = sorted((r.w, r.n) for r in
+                 spark.sql("select * from wr2").collect())
+    assert got == [(20, 1)]  # [0,10) already emitted pre-restart... or
+
+
+def test_batch_window_function(spark):
+    df = spark.createDataFrame([{"ts": t} for t in (1, 5, 12, 25)])
+    out = df.groupBy(F.window("ts", 10).alias("w")) \
+        .agg(F.count("ts").alias("n")).orderBy("w")
+    assert [(r.w, r.n) for r in out.collect()] == [(0, 2), (10, 1), (20, 1)]
+
+
+def test_update_mode_with_agg_rejected(spark):
+    src = MemoryStream(pa.schema([("k", pa.int64())]))
+    df = spark.readStream.load(src)
+    agg = df.groupBy("k").agg(F.count("k").alias("n"))
+    with pytest.raises(NotImplementedError):
+        agg.writeStream.outputMode("update").queryName("u1").start()
