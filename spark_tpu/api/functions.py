@@ -174,6 +174,56 @@ def when(condition: Column, value: Any) -> E.Case:
     return E.Case(((condition, lit(value)),), None)
 
 
+def floor(c: ColumnOrName) -> Column:
+    return E.UnaryMath("floor", _c(c))
+
+
+def ceil(c: ColumnOrName) -> Column:
+    return E.UnaryMath("ceil", _c(c))
+
+
+def sqrt(c: ColumnOrName) -> Column:
+    return E.UnaryMath("sqrt", _c(c))
+
+
+def exp(c: ColumnOrName) -> Column:
+    return E.UnaryMath("exp", _c(c))
+
+
+def log(c: ColumnOrName) -> Column:
+    return E.UnaryMath("ln", _c(c))
+
+
+ln = log
+
+
+def log10(c: ColumnOrName) -> Column:
+    return E.UnaryMath("log10", _c(c))
+
+
+def signum(c: ColumnOrName) -> Column:
+    return E.UnaryMath("sign", _c(c))
+
+
+def round(c: ColumnOrName, scale: int = 0) -> Column:  # noqa: A001
+    return E.Round(_c(c), scale)
+
+
+def pow(a: ColumnOrName, b) -> Column:  # noqa: A001
+    return E.Pow(_c(a), lit(b) if not isinstance(b, E.Expression) else b)
+
+
+power = pow
+
+
+def approx_count_distinct(c: ColumnOrName, rsd: float = 0.05) -> Column:
+    """Distinct-count estimate (reference: approx_count_distinct /
+    HyperLogLog++). Implemented EXACTLY via the DISTINCT-aggregate
+    dedup kernel — a valid estimator with rsd=0; the sketch module
+    (spark_tpu.sketch) provides mergeable CMS/Bloom structures."""
+    return E.Count(_c(c), distinct=True)
+
+
 # ---- string ----------------------------------------------------------------
 
 
@@ -195,6 +245,47 @@ def contains(c: ColumnOrName, needle: str) -> Column:
 
 def like(c: ColumnOrName, pattern: str) -> Column:
     return E.Like(_c(c), pattern)
+
+
+def upper(c: ColumnOrName) -> Column:
+    return E.StringTransform("upper", _c(c))
+
+
+def lower(c: ColumnOrName) -> Column:
+    return E.StringTransform("lower", _c(c))
+
+
+def trim(c: ColumnOrName) -> Column:
+    return E.StringTransform("trim", _c(c))
+
+
+def ltrim(c: ColumnOrName) -> Column:
+    return E.StringTransform("ltrim", _c(c))
+
+
+def rtrim(c: ColumnOrName) -> Column:
+    return E.StringTransform("rtrim", _c(c))
+
+
+def length(c: ColumnOrName) -> Column:
+    return E.StrLength(_c(c))
+
+
+def regexp_extract(c: ColumnOrName, pattern: str, idx: int = 1) -> Column:
+    return E.RegexpExtract(_c(c), pattern, idx)
+
+
+def regexp_replace(c: ColumnOrName, pattern: str,
+                   replacement: str) -> Column:
+    return E.RegexpReplace(_c(c), pattern, replacement)
+
+
+def rlike(c: ColumnOrName, pattern: str) -> Column:
+    return E.RegexpLike(_c(c), pattern)
+
+
+def concat(*cols: ColumnOrName) -> Column:
+    return E.Concat(tuple(_c(c) for c in cols))
 
 
 # ---- temporal --------------------------------------------------------------
@@ -230,6 +321,14 @@ def datediff(end: ColumnOrName, start: ColumnOrName) -> Column:
 
 def to_date(c: ColumnOrName) -> Column:
     return E.Cast(_c(c), T.DATE)
+
+
+def date_trunc(unit: str, c: ColumnOrName) -> Column:
+    return E.DateTrunc(unit.lower(), _c(c))
+
+
+def last_day(c: ColumnOrName) -> Column:
+    return E.LastDay(_c(c))
 
 
 # ---- ordering --------------------------------------------------------------

@@ -302,6 +302,114 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
         part = {"year": y, "month": m, "day": d}[expr.part]
         return TV(part.astype(jnp.int32), tv.validity, T.INT32, None)
 
+    if isinstance(expr, E.UnaryMath):
+        tv = evaluate(expr.child, env)
+        x = tv.data
+        if expr.op in ("floor", "ceil") and tv.dtype.is_integral:
+            # identity on integers — a float64 round-trip would corrupt
+            # values above 2^53
+            return TV(x.astype(jnp.int64), tv.validity, T.INT64, None)
+        if expr.op == "floor":
+            out = jnp.floor(x.astype(jnp.float64)).astype(jnp.int64)
+        elif expr.op == "ceil":
+            out = jnp.ceil(x.astype(jnp.float64)).astype(jnp.int64)
+        elif expr.op == "sign":
+            out = jnp.sign(x)
+        else:
+            xf = x.astype(jnp.float64)
+            out = {"sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log,
+                   "log10": jnp.log10}[expr.op](xf)
+        dt = (T.INT64 if expr.op in ("floor", "ceil")
+              else (tv.dtype if expr.op == "sign" else T.FLOAT64))
+        return TV(out, tv.validity, dt, None)
+
+    if isinstance(expr, E.Round):
+        tv = evaluate(expr.child, env)
+        if tv.dtype.is_integral and expr.scale >= 0:
+            return tv
+        x = tv.data.astype(jnp.float64)
+        f = 10.0 ** expr.scale
+        # HALF_UP (Spark) — numpy/jax round is half-even
+        out = jnp.sign(x) * jnp.floor(jnp.abs(x) * f + 0.5) / f
+        if tv.dtype.is_integral:
+            # negative scale on an integral column stays integral
+            # (matches Round.data_type)
+            return TV(out.astype(jnp.int64), tv.validity, T.INT64, None)
+        return TV(out, tv.validity, T.FLOAT64, None)
+
+    if isinstance(expr, E.Pow):
+        lt = evaluate(expr.left, env)
+        rt = evaluate(expr.right, env)
+        out = jnp.power(lt.data.astype(jnp.float64),
+                        rt.data.astype(jnp.float64))
+        validity = None
+        if lt.validity is not None or rt.validity is not None:
+            validity = lt.valid_or_true(n) & rt.valid_or_true(n)
+        return TV(out, validity, T.FLOAT64, None)
+
+    if isinstance(expr, E.StringTransform):
+        tv = evaluate(expr.child, env)
+        fn = {"upper": str.upper, "lower": str.lower, "trim": str.strip,
+              "ltrim": str.lstrip, "rtrim": str.rstrip}[expr.op]
+        return _dict_transform(tv, fn, n)
+
+    if isinstance(expr, E.StrLength):
+        tv = evaluate(expr.child, env)
+        dictionary = tv.dictionary or ()
+        table = np.array([len(s) for s in dictionary] or [0],
+                         dtype=np.int32)
+        return TV(jnp.asarray(table)[tv.data], tv.validity, T.INT32, None)
+
+    if isinstance(expr, E.RegexpExtract):
+        import re as _re
+
+        rx = _re.compile(expr.pattern)
+
+        def extract(s: str) -> str:
+            m = rx.search(s)
+            if m is None:
+                return ""
+            try:
+                return m.group(expr.group) or ""
+            except IndexError:
+                return ""
+
+        tv = evaluate(expr.child, env)
+        return _dict_transform(tv, extract, n)
+
+    if isinstance(expr, E.RegexpReplace):
+        import re as _re
+
+        rx = _re.compile(expr.pattern)
+        tv = evaluate(expr.child, env)
+        return _dict_transform(tv, lambda s: rx.sub(expr.replacement, s), n)
+
+    if isinstance(expr, E.RegexpLike):
+        import re as _re
+
+        rx = _re.compile(expr.pattern)
+        tv = evaluate(expr.child, env)
+        dictionary = tv.dictionary or ()
+        table = np.array([bool(rx.search(s)) for s in dictionary] or [False])
+        return TV(jnp.asarray(table)[tv.data], tv.validity, T.BOOLEAN, None)
+
+    if isinstance(expr, E.DateTrunc):
+        tv = evaluate(expr.child, env)
+        y, m, d = _civil_from_days(tv.data.astype(jnp.int64))
+        if expr.unit in ("year", "yy", "yyyy"):
+            days = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        elif expr.unit in ("month", "mon", "mm"):
+            days = _days_from_civil(y, m, jnp.ones_like(d))
+        else:
+            raise NotImplementedError(f"date_trunc unit {expr.unit!r}")
+        return TV(days.astype(jnp.int32), tv.validity, T.DATE, None)
+
+    if isinstance(expr, E.LastDay):
+        tv = evaluate(expr.child, env)
+        y, m, d = _civil_from_days(tv.data.astype(jnp.int64))
+        days = _days_from_civil(y, m, _days_in_month(y, m))
+        return TV(days.astype(jnp.int32), tv.validity, T.DATE, None)
+
     if isinstance(expr, E.AddMonths):
         tv = evaluate(expr.child, env)
         y, m, d = _civil_from_days(tv.data.astype(jnp.int64))
@@ -349,6 +457,20 @@ def _days_in_month(y: jnp.ndarray, m: jnp.ndarray):
     leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
     base = lengths[m - 1]
     return jnp.where((m == 2) & leap, base + 1, base)
+
+
+def _dict_transform(tv: TV, fn, n: int) -> TV:
+    """Apply a host string function over the dictionary; codes remap
+    through a translation table on device (the pattern every string
+    expression uses — strings never materialize on the TPU)."""
+    dictionary = tv.dictionary or ()
+    transformed = [fn(s) for s in dictionary]
+    new_dict = tuple(sorted(set(transformed)))
+    pos = {s: i for i, s in enumerate(new_dict)}
+    table = np.array([pos[t] for t in transformed] or [0], dtype=np.int32)
+    codes = (jnp.asarray(table)[tv.data] if len(dictionary)
+             else jnp.zeros((n,), dtype=jnp.int32))
+    return TV(codes, tv.validity, T.STRING, new_dict)
 
 
 def _eval_arith(expr: E.Arith, env: Env) -> TV:

@@ -580,6 +580,178 @@ class Abs(Expression):
         return f"ABS({self.child})"
 
 
+@dataclass(eq=False, frozen=True)
+class UnaryMath(Expression):
+    """floor/ceil/sqrt/exp/ln/log10/sign (reference: catalyst
+    expressions/mathExpressions.scala)."""
+
+    op: str
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        if self.op in ("floor", "ceil"):
+            return T.INT64
+        if self.op == "sign":
+            return self.child.data_type(schema)
+        return T.FLOAT64
+
+    def __str__(self):
+        return f"{self.op.upper()}({self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class Round(Expression):
+    """ROUND(x, scale) with HALF_UP ties (Spark semantics; numpy rounds
+    half-even)."""
+
+    child: Expression
+    scale: int = 0
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        dt = self.child.data_type(schema)
+        return dt if dt.is_integral else T.FLOAT64
+
+    def __str__(self):
+        return f"ROUND({self.child}, {self.scale})"
+
+
+@dataclass(eq=False, frozen=True)
+class Pow(Expression):
+    left: Expression
+    right: Expression
+
+    def children(self):
+        return (self.left, self.right)
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    def __str__(self):
+        return f"POWER({self.left}, {self.right})"
+
+
+@dataclass(eq=False, frozen=True)
+class StringTransform(Expression):
+    """upper/lower/trim/ltrim/rtrim — host dictionary transforms
+    (reference: stringExpressions.scala Upper/Lower/StringTrim)."""
+
+    op: str
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def __str__(self):
+        return f"{self.op.upper()}({self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class StrLength(Expression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def __str__(self):
+        return f"LENGTH({self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class RegexpExtract(Expression):
+    """regexp_extract(str, pattern, group) (reference:
+    regexpExpressions.scala RegExpExtract; no match -> '')."""
+
+    child: Expression
+    pattern: str
+    group: int = 1
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def __str__(self):
+        return f"REGEXP_EXTRACT({self.child}, {self.pattern!r}, {self.group})"
+
+
+@dataclass(eq=False, frozen=True)
+class RegexpReplace(Expression):
+    child: Expression
+    pattern: str
+    replacement: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def __str__(self):
+        return f"REGEXP_REPLACE({self.child}, {self.pattern!r})"
+
+
+@dataclass(eq=False, frozen=True)
+class RegexpLike(Expression):
+    """RLIKE / regexp_like — boolean regex match over the dictionary."""
+
+    child: Expression
+    pattern: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"({self.child} RLIKE {self.pattern!r})"
+
+
+@dataclass(eq=False, frozen=True)
+class DateTrunc(Expression):
+    """date_trunc('year'|'month', date) (reference:
+    datetimeExpressions.scala TruncDate)."""
+
+    unit: str
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.DATE
+
+    def __str__(self):
+        return f"DATE_TRUNC({self.unit!r}, {self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class LastDay(Expression):
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.DATE
+
+    def __str__(self):
+        return f"LAST_DAY({self.child})"
+
+
 # ---- window expressions -----------------------------------------------------
 
 
@@ -1049,11 +1221,26 @@ def transform_expr_down(e: Expression, fn) -> Expression:
             changed |= nv is not f_val
             new_fields[f_name] = nv
         elif isinstance(f_val, tuple) and any(
-                isinstance(x, Expression) for x in f_val):
-            nlist = tuple(
-                transform_expr_down(x, fn) if isinstance(x, Expression)
-                else x for x in f_val)
-            changed |= any(a is not b for a, b in zip(nlist, f_val))
+                isinstance(x, Expression)
+                or (isinstance(x, tuple)
+                    and any(isinstance(y, Expression) for y in x))
+                for x in f_val):
+            # handles tuple-of-tuple fields too (Case.branches)
+            nlist = []
+            for x in f_val:
+                if isinstance(x, Expression):
+                    nlist.append(transform_expr_down(x, fn))
+                elif isinstance(x, tuple):
+                    nlist.append(tuple(
+                        transform_expr_down(y, fn)
+                        if isinstance(y, Expression) else y for y in x))
+                else:
+                    nlist.append(x)
+            nlist = tuple(nlist)
+            changed |= any(
+                a is not b if not isinstance(a, tuple)
+                else any(p is not q for p, q in zip(a, b))
+                for a, b in zip(nlist, f_val))
             new_fields[f_name] = nlist
         else:
             new_fields[f_name] = f_val

@@ -1,0 +1,120 @@
+"""Probabilistic sketches (reference:
+common/sketch/src/main/java/org/apache/spark/util/sketch/
+CountMinSketch.java:54, BloomFilter.java:42 — used by
+DataFrameStatFunctions and runtime join filters).
+
+Device-native re-expression: both sketches are dense integer arrays
+updated with vectorized hashing over whole columns at once (the
+reference updates row-by-row in JVM loops). Merging is elementwise
+add/or, so sketches built per-device combine with a psum/any over the
+mesh — the exact pattern the reference uses to merge per-partition
+sketches on the driver."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_tpu.physical import kernels as K
+
+
+def _column_hashes(values, seeds: jnp.ndarray) -> jnp.ndarray:
+    """(n_seeds, n) uint64 hashes of an int64 column."""
+    x = jnp.asarray(values).astype(jnp.uint64)
+    return jax.vmap(lambda s: K.hash64(x ^ s))(seeds)
+
+
+class CountMinSketch:
+    """Conservative frequency estimation: depth x width counters;
+    estimate = min over rows (never under-counts)."""
+
+    def __init__(self, depth: int = 5, width: int = 2048,
+                 table: Optional[jnp.ndarray] = None, seed: int = 42):
+        self.depth = depth
+        self.width = width
+        self.seeds = jnp.asarray(
+            np.random.default_rng(seed).integers(1, 1 << 62, depth),
+            dtype=jnp.uint64)
+        self.table = (jnp.zeros((depth, width), dtype=jnp.int64)
+                      if table is None else table)
+
+    @classmethod
+    def for_rsd(cls, eps: float = 0.01, confidence: float = 0.99,
+                seed: int = 42) -> "CountMinSketch":
+        """Size from error bounds (reference: CountMinSketch.create)."""
+        width = int(math.ceil(2.0 / eps))
+        depth = int(math.ceil(-math.log(1 - confidence) / math.log(2)))
+        return cls(depth, width, seed=seed)
+
+    def add(self, values, mask=None) -> "CountMinSketch":
+        h = _column_hashes(values, self.seeds) % jnp.uint64(self.width)
+        ones = (jnp.ones(h.shape[1], jnp.int64) if mask is None
+                else jnp.asarray(mask).astype(jnp.int64))
+
+        def upd(row, idx):
+            return row.at[idx].add(ones)
+
+        table = jax.vmap(upd)(self.table, h.astype(jnp.int64))
+        return CountMinSketch(self.depth, self.width, table)
+
+    def estimate(self, value: int) -> int:
+        h = _column_hashes(jnp.asarray([value]), self.seeds) \
+            % jnp.uint64(self.width)
+        rows = self.table[jnp.arange(self.depth), h[:, 0].astype(jnp.int64)]
+        return int(rows.min())
+
+    def merge(self, other: "CountMinSketch") -> "CountMinSketch":
+        assert (self.depth, self.width) == (other.depth, other.width)
+        return CountMinSketch(self.depth, self.width,
+                              self.table + other.table)
+
+
+class BloomFilter:
+    """Membership filter; mergeable by OR (reference: BloomFilter.java:42
+    putLong/mightContainLong). False positives possible, negatives not."""
+
+    def __init__(self, num_bits: int = 1 << 16, num_hashes: int = 5,
+                 bits: Optional[jnp.ndarray] = None, seed: int = 7):
+        self.num_bits = num_bits
+        self.num_hashes = num_hashes
+        self.seeds = jnp.asarray(
+            np.random.default_rng(seed).integers(1, 1 << 62, num_hashes),
+            dtype=jnp.uint64)
+        self.bits = (jnp.zeros((num_bits,), dtype=jnp.bool_)
+                     if bits is None else bits)
+
+    @classmethod
+    def for_items(cls, expected: int, fpp: float = 0.03,
+                  seed: int = 7) -> "BloomFilter":
+        n_bits = int(-expected * math.log(fpp) / (math.log(2) ** 2))
+        n_bits = max(64, 1 << (n_bits - 1).bit_length())  # power of two
+        k = max(1, round(n_bits / expected * math.log(2)))
+        return cls(n_bits, k, seed=seed)
+
+    def add(self, values, mask=None) -> "BloomFilter":
+        h = _column_hashes(values, self.seeds) % jnp.uint64(self.num_bits)
+        on = (jnp.ones(h.shape[1], jnp.bool_) if mask is None
+              else jnp.asarray(mask))
+        bits = self.bits
+        for d in range(self.num_hashes):
+            bits = bits.at[h[d].astype(jnp.int64)].max(on)
+        return BloomFilter(self.num_bits, self.num_hashes, bits)
+
+    def might_contain(self, values) -> jnp.ndarray:
+        """Vectorized membership test for a whole column — this is the
+        runtime-join-filter shape (reference: InjectRuntimeFilter)."""
+        h = _column_hashes(values, self.seeds) % jnp.uint64(self.num_bits)
+        out = jnp.ones(h.shape[1], jnp.bool_)
+        for d in range(self.num_hashes):
+            out = out & self.bits[h[d].astype(jnp.int64)]
+        return out
+
+    def merge(self, other: "BloomFilter") -> "BloomFilter":
+        assert (self.num_bits, self.num_hashes) == \
+            (other.num_bits, other.num_hashes)
+        return BloomFilter(self.num_bits, self.num_hashes,
+                           self.bits | other.bits)

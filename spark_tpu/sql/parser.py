@@ -575,6 +575,72 @@ class _ExprParser:
             e = self.parse()
             self.expect(")")
             return E.Abs(e)
+        if name in ("FLOOR", "CEIL", "CEILING", "SQRT", "EXP", "LN",
+                    "LOG10", "SIGN"):
+            e = self.parse()
+            self.expect(")")
+            op = {"CEILING": "ceil"}.get(name, name.lower())
+            return E.UnaryMath(op, e)
+        if name == "ROUND":
+            e = self.parse()
+            scale = 0
+            if self.accept(","):
+                scale = self._int_literal()
+            self.expect(")")
+            return E.Round(e, scale)
+        if name in ("POWER", "POW"):
+            a = self.parse()
+            self.expect(",")
+            b = self.parse()
+            self.expect(")")
+            return E.Pow(a, b)
+        if name in ("UPPER", "LOWER", "TRIM", "LTRIM", "RTRIM"):
+            e = self.parse()
+            self.expect(")")
+            return E.StringTransform(name.lower(), e)
+        if name in ("LENGTH", "LEN", "CHAR_LENGTH"):
+            e = self.parse()
+            self.expect(")")
+            return E.StrLength(e)
+        if name == "REGEXP_EXTRACT":
+            e = self.parse()
+            self.expect(",")
+            pat = self._str_literal()
+            group = 1
+            if self.accept(","):
+                group = self._int_literal()
+            self.expect(")")
+            return E.RegexpExtract(e, pat, group)
+        if name == "REGEXP_REPLACE":
+            e = self.parse()
+            self.expect(",")
+            pat = self._str_literal()
+            self.expect(",")
+            rep = self._str_literal()
+            self.expect(")")
+            return E.RegexpReplace(e, pat, rep)
+        if name == "REGEXP_LIKE":
+            e = self.parse()
+            self.expect(",")
+            pat = self._str_literal()
+            self.expect(")")
+            return E.RegexpLike(e, pat)
+        if name == "DATE_TRUNC":
+            unit = self._str_literal().lower()
+            self.expect(",")
+            e = self.parse()
+            self.expect(")")
+            return E.DateTrunc(unit, e)
+        if name == "LAST_DAY":
+            e = self.parse()
+            self.expect(")")
+            return E.LastDay(e)
+        if name == "APPROX_COUNT_DISTINCT":
+            e = self.parse()
+            if self.accept(","):
+                self.parse()  # rsd accepted, unused (result is exact)
+            self.expect(")")
+            return E.Count(e, distinct=True)
         if name == "NULLIF":
             a = self.parse()
             self.expect(",")
@@ -603,6 +669,12 @@ class _ExprParser:
             return E.AddMonths(e, m)
         raise SQLParseError(f"unknown function {name_tok.value!r} "
                             f"at {name_tok.pos}")
+
+    def _str_literal(self) -> str:
+        e = self.parse_primary()
+        if isinstance(e, E.Literal) and isinstance(e.value, str):
+            return e.value
+        raise SQLParseError("expected string literal")
 
     def _int_literal(self) -> int:
         e = self.parse_unary()

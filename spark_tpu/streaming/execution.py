@@ -162,6 +162,15 @@ class StreamingQuery:
             raise NotImplementedError(
                 "outputMode('update') with aggregation: use 'complete' "
                 "or 'append' (with a watermark)")
+        if self._agg is not None and output_mode == "append":
+            wm_col = self._src_node.watermark_col
+            has_time_key = wm_col is not None and any(
+                wm_col in g.references() for g in self._agg.groupings)
+            if not has_time_key:
+                raise NotImplementedError(
+                    "append-mode streaming aggregation requires a "
+                    "watermark and an event-time grouping key "
+                    "(reference: UnsupportedOperationChecker)")
         self._register_sink()
         self.is_active = True
 
@@ -177,6 +186,13 @@ class StreamingQuery:
             raise NotImplementedError(
                 "multiple aggregations in one streaming query")
         agg = aggs[0]
+        if agg is not self._plan:
+            # operators above the aggregate (filter/select/sort on the
+            # result) are not incrementalized yet; refusing beats
+            # silently dropping them
+            raise NotImplementedError(
+                "operators above a streaming aggregation are not "
+                "supported; aggregate must be the query root")
         return _AggSpec(agg), agg, agg.child
 
     # -- execution ------------------------------------------------------------

@@ -25,8 +25,6 @@ class MemoryStream:
     reference: sql/core/src/test/.../streaming/StreamTest.scala:342)."""
 
     def __init__(self, schema_or_example):
-        import pandas as pd
-
         if isinstance(schema_or_example, pa.Table):
             self._example = schema_or_example.schema
         else:

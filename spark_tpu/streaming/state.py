@@ -67,10 +67,14 @@ class OffsetLog:
             for sub in ("offsets", "commits"):
                 os.makedirs(os.path.join(self._dir, sub), exist_ok=True)
             for fn in os.listdir(os.path.join(self._dir, "offsets")):
+                if not fn.endswith(".json"):
+                    continue  # leftover .tmp from a crash mid-write
                 b = int(fn.split(".")[0])
                 with open(os.path.join(self._dir, "offsets", fn)) as f:
                     self._offsets[b] = json.load(f)
             for fn in os.listdir(os.path.join(self._dir, "commits")):
+                if not fn.endswith(".json"):
+                    continue
                 b = int(fn.split(".")[0])
                 self._commits.add(b)
                 with open(os.path.join(self._dir, "commits", fn)) as f:

@@ -1,0 +1,160 @@
+"""Math/string/regexp/date expression batch + sketches, oracle-checked
+against sqlite3 where it implements the function, python otherwise
+(reference: catalyst expressions/ packages,
+common/sketch/.../CountMinSketch.java:54)."""
+
+import math
+import sqlite3
+
+import pytest
+
+from spark_tpu.api import functions as F
+
+ROWS = [
+    {"s": "  Hello ", "x": 2.567, "n": 4, "d": "1995-03-17"},
+    {"s": "WORLD", "x": -3.21, "n": 9, "d": "1996-12-01"},
+    {"s": "claude v5", "x": 0.5, "n": 16, "d": "2000-02-29"},
+]
+
+
+@pytest.fixture(scope="module")
+def edf(spark):
+    import datetime
+
+    rows = [dict(r, d=datetime.date.fromisoformat(r["d"])) for r in ROWS]
+    df = spark.createDataFrame(rows)
+    df.createOrReplaceTempView("exprs")
+    conn = sqlite3.connect(":memory:")
+    conn.execute("create table exprs (s text, x real, n int, d text)")
+    conn.executemany("insert into exprs values (?,?,?,?)",
+                     [(r["s"], r["x"], r["n"], r["d"]) for r in ROWS])
+    return spark, conn
+
+
+@pytest.mark.parametrize("fn", ["upper(s)", "lower(s)", "trim(s)",
+                                "ltrim(s)", "rtrim(s)", "length(s)",
+                                "abs(x)", "round(x)"])
+def test_sqlite_checked(edf, fn):
+    spark, conn = edf
+    got = sorted(str(r.asDict()["v"]) for r in
+                 spark.sql(f"select {fn} as v from exprs").collect())
+    want = sorted(str(v[0]) for v in
+                  conn.execute(f"select {fn} from exprs").fetchall())
+    assert got == want, f"{fn}: {got} != {want}"
+
+
+def test_signum(spark):
+    # Spark's signum returns DOUBLE (sqlite's sign returns int)
+    rows = spark.sql("select sign(x) as g from exprs").collect()
+    assert sorted(r.g for r in rows) == [-1.0, 1.0, 1.0]
+
+
+def test_math_functions(spark):
+    rows = spark.sql(
+        "select sqrt(n) as sq, exp(0.0) as e, ln(n) as l, log10(n) as lg,"
+        " power(n, 2) as p, floor(x) as f, ceil(x) as c, round(x, 1) as r "
+        "from exprs").collect()
+    by_n = {round(r.sq ** 2): r for r in rows}
+    assert by_n[4].sq == pytest.approx(2.0)
+    assert by_n[4].e == pytest.approx(1.0)
+    assert by_n[16].l == pytest.approx(math.log(16))
+    assert by_n[16].lg == pytest.approx(math.log10(16))
+    assert by_n[9].p == pytest.approx(81.0)
+    assert (by_n[4].f, by_n[4].c) == (2, 3)
+    assert by_n[9].r == pytest.approx(-3.2)
+    assert by_n[4].r == pytest.approx(2.6)  # HALF_UP, not banker's
+
+
+def test_round_half_up(spark):
+    df = spark.createDataFrame([{"v": 2.5}, {"v": 3.5}, {"v": -2.5}])
+    got = sorted(r.r for r in
+                 df.select(F.round("v").alias("r")).collect())
+    assert got == [-3.0, 3.0, 4.0]  # HALF_UP like Spark, not half-even
+
+
+def test_regexp(spark):
+    rows = spark.sql(
+        "select regexp_extract(s, '([a-z]+) v([0-9]+)', 2) as ver, "
+        "regexp_replace(s, '[aeiou]', '_') as repl, "
+        "regexp_like(s, '^[A-Z]+$') as caps from exprs").collect()
+    by_repl = {r.repl: r for r in rows}
+    assert any(r.ver == "5" for r in rows)
+    assert "cl__d_ v5" in by_repl
+    assert by_repl["WORLD"].caps is True
+    assert by_repl["cl__d_ v5"].caps is False
+
+
+def test_date_trunc_last_day(spark):
+    import datetime
+
+    rows = spark.sql(
+        "select date_trunc('month', d) as m, date_trunc('year', d) as y, "
+        "last_day(d) as ld from exprs").collect()
+    got = {(r.m, r.y, r.ld) for r in rows}
+    assert (datetime.date(2000, 2, 1), datetime.date(2000, 1, 1),
+            datetime.date(2000, 2, 29)) in got  # leap year
+    assert (datetime.date(1995, 3, 1), datetime.date(1995, 1, 1),
+            datetime.date(1995, 3, 31)) in got
+
+
+def test_approx_count_distinct(spark):
+    df = spark.createDataFrame([{"k": i % 7, "g": i % 2}
+                                for i in range(200)])
+    df.createOrReplaceTempView("acd")
+    rows = spark.sql(
+        "select g, approx_count_distinct(k) as n from acd "
+        "group by g order by g").collect()
+    assert [(r.g, r.n) for r in rows] == [(0, 7), (1, 7)]
+    out = df.agg(F.approx_count_distinct("k").alias("n")).collect()
+    assert out[0].n == 7
+
+
+def test_count_min_sketch():
+    import numpy as np
+
+    from spark_tpu.sketch import CountMinSketch
+
+    rng = np.random.default_rng(3)
+    vals = rng.integers(0, 50, 5000)
+    cms = CountMinSketch(depth=5, width=4096).add(vals)
+    truth = {v: int((vals == v).sum()) for v in range(50)}
+    for v in range(50):
+        est = cms.estimate(v)
+        assert est >= truth[v]             # never under-counts
+        assert est <= truth[v] + 30        # tight at this width
+    # mergeability (the per-device psum pattern)
+    half = CountMinSketch(depth=5, width=4096)
+    a, b = half.add(vals[:2500]), half.add(vals[2500:])
+    merged = a.merge(b)
+    assert merged.estimate(7) == cms.estimate(7)
+
+
+def test_bloom_filter():
+    import numpy as np
+
+    from spark_tpu.sketch import BloomFilter
+
+    rng = np.random.default_rng(4)
+    present = rng.integers(0, 1 << 40, 2000)
+    absent = rng.integers(1 << 41, 1 << 42, 2000)
+    bf = BloomFilter.for_items(2000, fpp=0.03).add(present)
+    assert bool(bf.might_contain(present).all())  # no false negatives
+    fp = float(np.asarray(bf.might_contain(absent)).mean())
+    assert fp < 0.1
+    # merge
+    b1 = BloomFilter.for_items(2000, fpp=0.03).add(present[:1000])
+    b2 = BloomFilter.for_items(2000, fpp=0.03).add(present[1000:])
+    assert bool(b1.merge(b2).might_contain(present).all())
+
+
+def test_round_negative_scale_integral(spark):
+    df = spark.createDataFrame([{"i": 1234}, {"i": 1285}])
+    got = sorted(r.r for r in
+                 df.select(F.round("i", -2).alias("r")).collect())
+    assert got == [1200, 1300]
+
+
+def test_floor_large_int_identity(spark):
+    big = (1 << 60) + 1
+    df = spark.createDataFrame([{"i": big}])
+    assert df.select(F.floor("i").alias("f")).collect()[0].f == big

@@ -212,3 +212,20 @@ def test_update_mode_with_agg_rejected(spark):
     agg = df.groupBy("k").agg(F.count("k").alias("n"))
     with pytest.raises(NotImplementedError):
         agg.writeStream.outputMode("update").queryName("u1").start()
+
+
+def test_ops_above_streaming_agg_rejected(spark):
+    src = MemoryStream(pa.schema([("k", pa.int64())]))
+    df = spark.readStream.load(src)
+    agg = df.groupBy("k").agg(F.count("k").alias("n")) \
+        .filter(F.col("n") > 5)
+    with pytest.raises(NotImplementedError):
+        agg.writeStream.outputMode("complete").queryName("x1").start()
+
+
+def test_append_agg_without_time_key_rejected(spark):
+    src = MemoryStream(pa.schema([("k", pa.int64())]))
+    agg = spark.readStream.load(src).groupBy("k") \
+        .agg(F.count("k").alias("n"))
+    with pytest.raises(NotImplementedError):
+        agg.writeStream.outputMode("append").queryName("x2").start()
