@@ -274,11 +274,36 @@ class DataFrame:
         metrics.query_start(self._plan.node_string())
         ex = getattr(self._session, "mesh_executor", None) \
             if self._session is not None else None
-        if ex is not None:
-            return ex.execute_logical(self._plan)
-        from spark_tpu.physical.planner import execute_logical
 
-        return execute_logical(self._plan)
+        def run(plan, optimize=True):
+            if ex is not None:
+                return ex.execute_logical(plan, optimize)
+            from spark_tpu.physical.planner import execute_logical
+
+            return execute_logical(plan, optimize)
+
+        def run_full(plan):
+            """Engine run with the out-of-HBM chunking decision applied
+            — also used to materialize cached plans so a cached big
+            aggregate chunks instead of OOMing."""
+            if self._session is None:
+                return run(plan)
+            from spark_tpu.physical.chunked import (execute_chunked,
+                                                    find_chunkable)
+            from spark_tpu.plan.optimizer import optimize as opt
+
+            lp = opt(plan)
+            found = find_chunkable(lp, self._session.conf)
+            if found is not None:
+                return execute_chunked(
+                    found, self._session.conf,
+                    lambda p: run(p, optimize=False))
+            return run(lp, optimize=False)
+
+        plan = self._plan
+        if self._session is not None:
+            plan = self._session.cache_manager.apply(plan, run_full)
+        return run_full(plan)
 
     def collect(self) -> List[Row]:
         batch = self._execute()
@@ -356,15 +381,18 @@ class DataFrame:
         self._session.catalog._register_view(name, self._plan)
 
     def cache(self) -> "DataFrame":
-        """Materialize once and swap in the result (reference:
-        CacheManager.scala / InMemoryRelation — here the 'columnar cached
-        build' is simply the executed device batch)."""
-        batch = self._execute()
-        return self._with(L.Relation(batch))
+        """Mark this plan cached (lazy — materialized on first use and
+        reused by ANY query containing it; reference: CacheManager.scala
+        / InMemoryRelation)."""
+        if self._session is not None:
+            self._session.cache_manager.add(self._plan)
+        return self
 
     persist = cache
 
     def unpersist(self) -> "DataFrame":
+        if self._session is not None:
+            self._session.cache_manager.drop(self._plan)
         return self
 
     def checkpoint(self) -> "DataFrame":

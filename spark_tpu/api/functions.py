@@ -74,6 +74,8 @@ def lead(c: ColumnOrName, offset: int = 1, default: Any = None) -> Column:
     return E.LagLead(_c(c), offset, d, lead=True)
 
 
+from spark_tpu.api.udf import arrow_udf, udf  # noqa: E402,F401
+
 # ---- aggregates ------------------------------------------------------------
 
 

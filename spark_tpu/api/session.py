@@ -57,6 +57,47 @@ def _enable_compilation_cache() -> None:
         pass  # older jax without these flags: in-memory caching only
 
 
+class CacheManager:
+    """Lazy in-memory plan cache (reference: CacheManager.scala +
+    InMemoryRelation): cache() registers the logical plan; the first
+    execution materializes it to a device Batch, and every later query
+    whose tree contains a cached subplan scans the materialized batch
+    instead of recomputing. Identity is structural (tree_string) plus
+    leaf-batch identity."""
+
+    def __init__(self):
+        self._entries: Dict[str, list] = {}
+
+    @staticmethod
+    def _key(plan: L.LogicalPlan) -> str:
+        ids = [str(id(n.batch)) for n in L.collect_nodes(plan, L.Relation)]
+        return plan.tree_string() + "||" + ",".join(ids)
+
+    def add(self, plan: L.LogicalPlan) -> None:
+        self._entries.setdefault(self._key(plan), [plan, None])
+
+    def drop(self, plan: L.LogicalPlan) -> bool:
+        return self._entries.pop(self._key(plan), None) is not None
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def apply(self, plan: L.LogicalPlan, run) -> L.LogicalPlan:
+        """Substitute cached subtrees (materializing on first use)."""
+        if not self._entries:
+            return plan
+
+        def fn(node: L.LogicalPlan) -> L.LogicalPlan:
+            entry = self._entries.get(self._key(node))
+            if entry is None:
+                return node
+            if entry[1] is None:
+                entry[1] = L.Relation(run(entry[0]))
+            return entry[1]
+
+        return plan.transform_up(fn)
+
+
 class Catalog:
     """Temp-view + table registry (reference:
     sql/catalyst/.../catalog/SessionCatalog.scala:61, pared to the
@@ -132,6 +173,7 @@ class SparkSession:
         self.app_name = app_name
         self.conf = RuntimeConf(conf)
         self.catalog = Catalog(self)
+        self.cache_manager = CacheManager()
         self._read = None
         self._mesh = None
         self._mesh_executor = None

@@ -1,0 +1,120 @@
+"""User-defined functions (reference:
+sql/core/.../execution/python/ArrowPythonRunner.scala,
+ArrowEvalPythonExec.scala, python/pyspark/sql/udf.py).
+
+Two tiers, mirroring the reference's pandas-UDF split but TPU-first:
+
+- **jax UDFs** (``@F.udf`` default): the function receives jnp arrays
+  and returns one; it traces INTO the fused stage program like any
+  built-in expression — zero interpreter involvement at execution time.
+  This is the preferred tier: the reference pays a JVM<->Python socket
+  round trip per batch (PythonRunner.scala:126), here the UDF *is* XLA.
+- **arrow UDFs** (``@F.arrow_udf``): the function receives/returns
+  pyarrow arrays and runs host-side per batch — for logic that cannot
+  trace (arbitrary Python). The column round-trips device->host->device
+  exactly once per batch, like the reference's Arrow stream to the
+  Python worker, but in-process (no fork server, no sockets).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+from spark_tpu import types as T
+from spark_tpu.expr import expressions as E
+from spark_tpu.types import DataType
+
+
+@dataclass(eq=False, frozen=True)
+class JaxUdf(E.Expression):
+    """Traceable UDF: fn(*jnp_arrays) -> jnp_array. Nulls: the result is
+    NULL where any input is NULL (Spark's null-intolerant default)."""
+
+    fn: Callable
+    return_type: DataType
+    args: Tuple[E.Expression, ...]
+    fn_name: str = "udf"
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return self.return_type
+
+    @property
+    def name(self):
+        return f"{self.fn_name}({', '.join(str(a) for a in self.args)})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class ArrowUdf(E.Expression):
+    """Host-side UDF over pyarrow arrays; evaluated eagerly between
+    stages (forces a stage break like a blocking operator)."""
+
+    fn: Callable
+    return_type: DataType
+    args: Tuple[E.Expression, ...]
+    fn_name: str = "arrow_udf"
+    blocks_trace = True
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return self.return_type
+
+    @property
+    def name(self):
+        return f"{self.fn_name}({', '.join(str(a) for a in self.args)})"
+
+    def __str__(self):
+        return self.name
+
+
+def udf(fn: Optional[Callable] = None, returnType: DataType = T.FLOAT64):
+    """Decorator/factory for jax UDFs:
+
+        @F.udf(returnType=T.FLOAT64)
+        def my_fn(x, y):            # jnp arrays in, jnp array out
+            return jnp.sqrt(x) + y
+
+        df.select(my_fn("a", "b"))
+    """
+
+    def wrap(f: Callable):
+        def build(*cols):
+            args = tuple(
+                c if isinstance(c, E.Expression) else E.Col(c)
+                for c in cols)
+            return JaxUdf(f, returnType, args, f.__name__)
+
+        build.__name__ = f.__name__
+        return build
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def arrow_udf(fn: Optional[Callable] = None,
+              returnType: DataType = T.FLOAT64):
+    """Decorator/factory for host-side pyarrow UDFs (the escape hatch
+    for untraceable Python)."""
+
+    def wrap(f: Callable):
+        def build(*cols):
+            args = tuple(
+                c if isinstance(c, E.Expression) else E.Col(c)
+                for c in cols)
+            return ArrowUdf(f, returnType, args, f.__name__)
+
+        build.__name__ = f.__name__
+        return build
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -42,11 +42,14 @@ class TV(NamedTuple):
 
 
 class Env:
-    """Column environment for evaluation: name -> TV, plus row count."""
+    """Column environment for evaluation: name -> TV, plus row count.
+    ``mask`` (optional) is the live-row mask — host UDFs use it to show
+    dead rows as NULL instead of leaking garbage slot values."""
 
-    def __init__(self, columns: Dict[str, TV], capacity: int):
+    def __init__(self, columns: Dict[str, TV], capacity: int, mask=None):
         self.columns = columns
         self.capacity = capacity
+        self.mask = mask
 
     @classmethod
     def from_batch(cls, batch) -> "Env":
@@ -147,6 +150,25 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
 
     if isinstance(expr, E.Alias):
         return evaluate(expr.child, env)
+
+    if type(expr).__name__ == "JaxUdf":
+        tvs = [evaluate(a, env) for a in expr.args]
+        out = expr.fn(*[tv.data for tv in tvs])
+        validity = None
+        for tv in tvs:
+            validity = _and_validity(validity, tv.validity)
+        return TV(out, validity, expr.return_type, None)
+
+    if type(expr).__name__ == "ArrowUdf":
+        # host round trip: only legal on the eager (blocking) path —
+        # np.asarray of a tracer fails loudly under jit. Dead rows show
+        # as NULL so Python logic never sees garbage slot values.
+        tvs = [evaluate(a, env) for a in expr.args]
+        dead = (None if env.mask is None
+                else ~np.asarray(env.mask))
+        arrays = [_tv_to_arrow(tv, n, dead) for tv in tvs]
+        out = expr.fn(*arrays)
+        return _arrow_to_tv(out, expr.return_type, n)
 
     if isinstance(expr, E.TumblingWindow):
         # batch evaluation: window start = child - child % width
@@ -457,6 +479,52 @@ def _days_in_month(y: jnp.ndarray, m: jnp.ndarray):
     leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
     base = lengths[m - 1]
     return jnp.where((m == 2) & leap, base + 1, base)
+
+
+def _tv_to_arrow(tv: TV, n: int, dead=None):
+    """Concrete TV -> pyarrow array: nulls from validity AND the dead-row
+    mask (host UDFs see NULL for dead slots, never garbage); dictionary
+    codes decode to strings."""
+    import pyarrow as pa
+
+    data = np.asarray(tv.data)
+    mask = (None if tv.validity is None
+            else ~np.asarray(tv.validity))
+    if dead is not None:
+        mask = dead if mask is None else (mask | dead)
+    if isinstance(tv.dtype, T.StringType):
+        d = list(tv.dictionary or ()) + [""]
+        codes = np.clip(data, 0, len(d) - 1)
+        vals = np.array(d, dtype=object)[codes]
+        return pa.array(vals, type=pa.string(),
+                        mask=mask if mask is not None else None)
+    if isinstance(tv.dtype, T.DateType):
+        return pa.array(data.astype("datetime64[D]"), mask=mask)
+    return pa.array(data, mask=mask)
+
+
+def _arrow_to_tv(arr, dtype: DataType, n: int) -> TV:
+    """pyarrow array -> TV (dictionary-encodes strings)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if len(arr) != n:
+        raise ValueError(
+            f"arrow UDF returned {len(arr)} rows, expected {n}")
+    validity = None
+    if arr.null_count:
+        validity = jnp.asarray(np.asarray(pc.is_valid(arr)))
+    if isinstance(dtype, T.StringType):
+        enc = pc.dictionary_encode(arr.combine_chunks()
+                                   if isinstance(arr, pa.ChunkedArray)
+                                   else arr)
+        dictionary = tuple(enc.dictionary.to_pylist())
+        codes = np.asarray(enc.indices.fill_null(0))
+        return TV(jnp.asarray(codes.astype(np.int32)), validity,
+                  T.STRING, dictionary)
+    np_arr = np.asarray(arr.fill_null(0) if arr.null_count else arr)
+    return TV(jnp.asarray(np_arr.astype(_jnp_dtype(dtype))), validity,
+              dtype, None)
 
 
 def _dict_transform(tv: TV, fn, n: int) -> TV:

@@ -28,6 +28,10 @@ from spark_tpu.types import DataType, Schema
 class Expression:
     """Base class. Subclasses are frozen dataclasses."""
 
+    #: True for expressions that cannot run inside a jit trace (host
+    #: UDFs); operators containing one execute eagerly between stages.
+    blocks_trace: bool = False
+
     def children(self) -> Tuple["Expression", ...]:
         return ()
 
@@ -907,6 +911,14 @@ def window_dictionary(w: "WindowExpr", schema) -> Optional[tuple]:
     if isinstance(c, Col) and c.col_name in schema:
         return schema.field(c.col_name).dictionary
     return None
+
+
+def contains_blocking(e: Expression) -> bool:
+    """Any host-only (untraceable) expression below — forces the
+    enclosing operator onto the eager path."""
+    if e.blocks_trace:
+        return True
+    return any(contains_blocking(c) for c in e.children())
 
 
 def contains_window(e: Expression) -> bool:

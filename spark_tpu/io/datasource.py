@@ -222,5 +222,42 @@ class FileSource:
         self._cache[key] = batch
         return batch
 
+    def count_rows(self, filters: Tuple[E.Expression, ...] = ()) -> int:
+        """Row count without materializing (drives the out-of-HBM
+        chunking decision)."""
+        return self._open().count_rows(filter=_filters_to_pads(filters))
+
+    def iter_batches(self, columns: Optional[Tuple[str, ...]] = None,
+                     filters: Tuple[E.Expression, ...] = (),
+                     rows_per_chunk: int = 1 << 20):
+        """Stream the scan as bounded arrow tables WITHOUT materializing
+        the whole dataset — host RAM is the staging tier for
+        larger-than-HBM execution (reference spill analogue:
+        ExternalSorter.scala:93; here the data never needed to be
+        device-resident in the first place)."""
+        import pyarrow as pa
+
+        ds = self._open()
+        pending: list = []
+        n = 0
+        for rb in ds.to_batches(
+                columns=list(columns) if columns is not None else None,
+                filter=_filters_to_pads(filters),
+                batch_size=rows_per_chunk):
+            if rb.num_rows == 0:
+                continue
+            if pending and n + rb.num_rows > rows_per_chunk:
+                # flush BEFORE exceeding the bound: a chunk never grows
+                # past rows_per_chunk + one record batch
+                yield pa.Table.from_batches(pending)
+                pending, n = [], 0
+            pending.append(rb)
+            n += rb.num_rows
+            if n >= rows_per_chunk:
+                yield pa.Table.from_batches(pending)
+                pending, n = [], 0
+        if pending:
+            yield pa.Table.from_batches(pending)
+
     def __repr__(self):
         return f"{self.fmt}:{','.join(self.paths)}"

@@ -81,8 +81,8 @@ def _strip_leaves(plan: P.PhysicalPlan) -> P.PhysicalPlan:
 def _fully_traceable(plan: P.PhysicalPlan) -> bool:
     if isinstance(plan, D.ShardScanExec):
         return True
-    return plan.traceable and all(_fully_traceable(c)
-                                  for c in plan.children())
+    return (plan.traceable and not plan.has_blocking_exprs()
+            and all(_fully_traceable(c) for c in plan.children()))
 
 
 @dataclass(eq=False)
@@ -242,6 +242,33 @@ class MeshExecutor:
         if not distinct_aggs and (probe._static_direct_ok() or not groupings):
             # no shuffle: local partial + psum merge
             return D.PSumAggExec(groupings, aggregates, child)
+        if not distinct_aggs:
+            # map-side combine (reference: AggUtils partial/final split):
+            # local partial aggregation BEFORE the exchange collapses a
+            # hot key to ONE row per device — a 90%-one-key distribution
+            # exchanges D rows instead of the whole table (the skew
+            # guard OptimizeSkewedJoin provides for joins).
+            from spark_tpu.plan.incremental import AggSpec
+
+            try:
+                spec = AggSpec(tuple(groupings), tuple(aggregates))
+            except NotImplementedError:
+                spec = None
+            if spec is not None:
+                key_aliases = tuple(
+                    E.Alias(g, n) for g, n
+                    in zip(spec.groupings_exec, spec.key_names))
+                partial = D.DistSortAggExec(
+                    tuple(spec.groupings_exec),
+                    key_aliases + tuple(spec.partials), child)
+                ex = D.HashPartitionExchangeExec(
+                    tuple(E.Col(n) for n in spec.key_names), partial)
+                key_cols = tuple(E.Col(n) for n in spec.key_names)
+                final = D.DistSortAggExec(
+                    key_cols,
+                    tuple(E.Alias(E.Col(n), n) for n in spec.key_names)
+                    + tuple(spec.merges), ex)
+                return P.ProjectExec(tuple(spec.outputs), final)
         # exchange on the grouping keys -> whole groups (and for DISTINCT
         # all their values) live on one device; local sort-agg is exact.
         ex = D.HashPartitionExchangeExec(tuple(groupings), child)
@@ -260,7 +287,12 @@ class MeshExecutor:
         plan = self._materialize_boundaries(plan)
         if isinstance(plan, D.ShardScanExec):
             return plan.sharded
-        assert _fully_traceable(plan), plan
+        if not _fully_traceable(plan):
+            raise NotImplementedError(
+                "plan contains host-only (arrow UDF) expressions, which "
+                "the mesh executor cannot trace; run on the "
+                "single-device engine or use a jax UDF:\n"
+                + plan.tree_string())
         return self._run_stage(plan)
 
     def _materialize_boundaries(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:

@@ -1,0 +1,153 @@
+"""Out-of-HBM execution: chunked scan-aggregation.
+
+A v5e chip holds ~16 GB of HBM; TPC-H SF100 lineitem alone is ~80 GB.
+When an aggregation's scan would exceed the device budget
+(spark.tpu.maxDeviceBatchBytes), the plan is NOT materialized: the
+parquet dataset streams through host RAM in bounded chunks, each chunk's
+PARTIAL aggregates run on device as an ordinary batch query, and
+partials merge through the same accumulator decomposition streaming uses
+(plan/incremental.AggSpec). Peak device footprint = one chunk + the
+running state, independent of input size.
+
+Reference analogue: ExternalSorter.scala:93 spill-to-disk +
+TungstenAggregationIterator.scala:82 sort-merge fallback — except the
+reference spills mid-operator, while here the operator is re-planned as
+a merge over chunk partials (the map-side-combine shape of AggUtils).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_tpu import conf as CF
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+from spark_tpu.plan.incremental import AggSpec
+
+MAX_DEVICE_BATCH_BYTES = CF.register(
+    "spark.tpu.maxDeviceBatchBytes", 2 << 30,
+    "Scans whose materialized size would exceed this execute in bounded "
+    "host-RAM chunks with device-side partial aggregation (out-of-HBM "
+    "execution).", int)
+
+CHUNK_ROWS = CF.register(
+    "spark.tpu.chunkRows", 1 << 21,
+    "Rows per device chunk for out-of-HBM execution.", int)
+
+
+def _schema_width(schema) -> int:
+    from spark_tpu.expr.compiler import _jnp_dtype
+
+    width = 0
+    for f in schema.fields:
+        try:
+            width += np.dtype(_jnp_dtype(f.dtype)).itemsize
+        except Exception:
+            width += 8
+        if f.nullable:
+            width += 1
+    return width
+
+
+def find_chunkable(plan: L.LogicalPlan, conf) -> Optional[tuple]:
+    """Detect `...unary ops...(Aggregate(... over one big UnresolvedScan))`
+    and return (above_chain, aggregate, scan) when the scan exceeds the
+    device budget. ``above_chain`` are the unary nodes above the
+    aggregate, outermost first."""
+    budget = conf.get(MAX_DEVICE_BATCH_BYTES)
+    above: List[L.LogicalPlan] = []
+    node = plan
+    while isinstance(node, (L.Project, L.Sort, L.Limit, L.Filter)) \
+            and not isinstance(node, L.Aggregate):
+        above.append(node)
+        node = node.children()[0]
+    if not isinstance(node, L.Aggregate):
+        return None
+    # the subtree below the aggregate must be PER-ROW only (Filter/
+    # Project/alias over the scan): anything order- or set-sensitive
+    # (Limit, Distinct, Window, Sample, Join, nested Aggregate) would be
+    # wrongly re-applied per chunk
+    def per_row_only(p: L.LogicalPlan) -> bool:
+        if isinstance(p, L.UnresolvedScan):
+            return True
+        if isinstance(p, (L.Filter, L.Project, L.SubqueryAlias)):
+            return per_row_only(p.children()[0])
+        return False
+
+    if not per_row_only(node.child):
+        return None
+    try:
+        AggSpec(node.groupings, node.aggregates)
+    except NotImplementedError:
+        return None  # non-mergeable aggregate: execute directly
+    scans = L.collect_nodes(node.child, L.UnresolvedScan)
+    if len(scans) != 1:
+        return None
+    scan = scans[0]
+    try:
+        rows = scan.source.count_rows(scan.filters)
+    except Exception:
+        return None
+    est = rows * _schema_width(scan.schema)
+    if est <= budget:
+        return None
+    return above, node, scan
+
+
+def execute_chunked(found: tuple, conf, run_fn) -> "object":
+    """Execute a chunkable plan (``found`` from find_chunkable);
+    ``run_fn(logical_plan) -> Batch`` is the engine (single-device or
+    mesh). Returns the final Batch."""
+    import pyarrow as pa
+
+    from spark_tpu import metrics
+    from spark_tpu.columnar.arrow import from_arrow, to_arrow
+
+    above, agg, scan = found
+    spec = AggSpec(agg.groupings, agg.aggregates)
+    key_aliases = tuple(E.Alias(g, n) for g, n
+                        in zip(spec.groupings_exec, spec.key_names))
+    chunk_rows = conf.get(CHUNK_ROWS)
+
+    state: Optional[pa.Table] = None
+    n_chunks = 0
+    for tbl in scan.source.iter_batches(scan.columns, scan.filters,
+                                        chunk_rows):
+        rel = L.Relation(from_arrow(tbl))
+
+        def splice(p: L.LogicalPlan) -> L.LogicalPlan:
+            if isinstance(p, L.UnresolvedScan):
+                return rel
+            return p
+
+        batch_child = agg.child.transform_up(splice)
+        partial = L.Aggregate(tuple(spec.groupings_exec),
+                              key_aliases + tuple(spec.partials),
+                              batch_child)
+        ptbl = to_arrow(run_fn(partial))
+        if state is not None and state.num_rows > 0:
+            merged_in = pa.concat_tables(
+                [state, ptbl.select(state.column_names)])
+        else:
+            merged_in = ptbl
+        keys = tuple(E.Col(n) for n in spec.key_names)
+        merged = L.Aggregate(
+            keys, tuple(E.Alias(E.Col(n), n) for n in spec.key_names)
+            + tuple(spec.merges), L.Relation(from_arrow(merged_in)))
+        state = to_arrow(run_fn(merged))
+        n_chunks += 1
+    metrics.record("chunked_agg", chunks=n_chunks,
+                   groups=0 if state is None else state.num_rows)
+
+    if state is None:  # empty scan: run the aggregate directly
+        final0: L.LogicalPlan = agg
+        for node in reversed(above):
+            final0 = node.with_children((final0,))
+        return run_fn(final0)
+    final: L.LogicalPlan = L.Project(tuple(spec.outputs),
+                                     L.Relation(from_arrow(state)))
+    for node in reversed(above):
+        final = node.with_children((final,))
+    return run_fn(final)

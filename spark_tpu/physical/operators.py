@@ -50,7 +50,7 @@ class Pipe:
         return int(self.mask.shape[0])
 
     def env(self) -> Env:
-        return Env(self.cols, self.capacity)
+        return Env(self.cols, self.capacity, self.mask)
 
     @classmethod
     def from_batch_data(cls, schema: Schema, data: BatchData) -> "Pipe":
@@ -126,6 +126,25 @@ class PhysicalPlan:
         """Structural cache key for fused-stage jit caching."""
         return (type(self).__name__,) + tuple(
             c.plan_key() for c in self.children())
+
+    def has_blocking_exprs(self) -> bool:
+        """Any host-only expression (arrow UDF) in THIS node's fields —
+        such an operator must run on the eager path regardless of its
+        own traceable flag."""
+        import dataclasses as _dc
+
+        def scan(v) -> bool:
+            if isinstance(v, E.Expression):
+                return E.contains_blocking(v)
+            if isinstance(v, tuple):
+                return any(scan(x) for x in v)
+            return False
+
+        try:
+            fields = _dc.fields(self)
+        except TypeError:
+            return False
+        return any(scan(getattr(self, f.name)) for f in fields)
 
     def __repr__(self):
         return self.tree_string()

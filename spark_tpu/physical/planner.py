@@ -82,7 +82,8 @@ _STAGE_CACHE: Dict[tuple, tuple] = {}
 def _fully_traceable(plan: P.PhysicalPlan) -> bool:
     if isinstance(plan, P.BatchScanExec):
         return True
-    return plan.traceable and all(_fully_traceable(c) for c in plan.children())
+    return (plan.traceable and not plan.has_blocking_exprs()
+            and all(_fully_traceable(c) for c in plan.children()))
 
 
 def _collect_scans(plan: P.PhysicalPlan, out: List[P.BatchScanExec]) -> None:

@@ -23,6 +23,7 @@ import pyarrow as pa
 
 from spark_tpu.expr import expressions as E
 from spark_tpu.plan import logical as L
+from spark_tpu.plan.incremental import AggSpec
 from spark_tpu.streaming.state import OffsetLog, StateStore
 
 _qids = itertools.count()
@@ -60,82 +61,6 @@ def _splice(plan: L.LogicalPlan, replacement: L.LogicalPlan):
         return p
 
     return plan.transform_up(fn)
-
-
-class _AggSpec:
-    """Accumulator decomposition of one streaming Aggregate node."""
-
-    def __init__(self, agg: L.Aggregate):
-        self.groupings = [E.strip_alias(g) for g in agg.groupings]
-        #: tumbling-window widths per grouping (None = not a window key);
-        #: the engine executes the window as plain arithmetic, the width
-        #: only matters for watermark eviction
-        self.window_widths = [
-            g.width if isinstance(g, E.TumblingWindow) else None
-            for g in self.groupings]
-        self.groupings_exec = [
-            g.as_arith() if isinstance(g, E.TumblingWindow) else g
-            for g in self.groupings]
-        self.key_names = [f"__k{i}" for i in range(len(self.groupings))]
-        self.partials: List[E.Alias] = []   # over input rows
-        self.merges: List[E.Alias] = []     # over union(state, partials)
-        self._final: Dict[tuple, E.Expression] = {}
-        for call in {E.expr_key(a): a
-                     for e in agg.aggregates
-                     for a in E.collect_aggregates(e)}.values():
-            self._add(call)
-        self.outputs: List[E.Alias] = []
-        key_map = {E.expr_key(g): E.Col(n)
-                   for g, n in zip(self.groupings, self.key_names)}
-
-        def repl(x: E.Expression) -> E.Expression:
-            # pre-order: an aggregate call is replaced wholesale BEFORE
-            # its children could be rewritten (count(k) grouped by k)
-            if isinstance(x, E.AggregateExpression):
-                return self._final[E.expr_key(x)]
-            k = E.expr_key(x)
-            if k in key_map:
-                return key_map[k]
-            return x
-
-        for e in agg.aggregates:
-            out = E.transform_expr_down(E.strip_alias(e), repl)
-            self.outputs.append(E.Alias(out, e.name))
-
-    def _acc(self, name: str, partial: E.Expression,
-             merge: E.Expression) -> None:
-        self.partials.append(E.Alias(partial, name))
-        self.merges.append(E.Alias(merge, name))
-
-    def _add(self, call: E.AggregateExpression) -> None:
-        if getattr(call, "distinct", False):
-            raise NotImplementedError(
-                "DISTINCT aggregates in streaming queries")
-        i = len(self.partials)
-        k = E.expr_key(call)
-        if isinstance(call, E.Count):
-            n = f"__a{i}"
-            self._acc(n, call, E.Sum(E.Col(n)))
-            self._final[k] = E.Coalesce((E.Col(n), E.Literal(0)))
-        elif isinstance(call, (E.Sum, E.Avg)):
-            s, c = f"__a{i}s", f"__a{i}n"
-            self._acc(s, E.Sum(call.child), E.Sum(E.Col(s)))
-            self._acc(c, E.Count(call.child), E.Sum(E.Col(c)))
-            nonzero = E.Cmp(">", E.Coalesce((E.Col(c), E.Literal(0))),
-                            E.Literal(0))
-            if isinstance(call, E.Sum):
-                self._final[k] = E.Case(((nonzero, E.Col(s)),), None)
-            else:
-                self._final[k] = E.Case(
-                    ((nonzero, E.Arith("/", E.Col(s), E.Col(c))),), None)
-        elif isinstance(call, (E.Min, E.Max)):
-            n = f"__a{i}"
-            cls = E.Min if isinstance(call, E.Min) else E.Max
-            self._acc(n, call, cls(E.Col(n)))
-            self._final[k] = E.Col(n)
-        else:
-            raise NotImplementedError(
-                f"streaming aggregate {call} is not mergeable here")
 
 
 class StreamingQuery:
@@ -193,7 +118,7 @@ class StreamingQuery:
             raise NotImplementedError(
                 "operators above a streaming aggregation are not "
                 "supported; aggregate must be the query root")
-        return _AggSpec(agg), agg, agg.child
+        return AggSpec(agg.groupings, agg.aggregates), agg, agg.child
 
     # -- execution ------------------------------------------------------------
 
