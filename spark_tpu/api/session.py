@@ -69,9 +69,9 @@ class CacheManager:
         self._entries: Dict[str, list] = {}
 
     @staticmethod
-    def _key(plan: L.LogicalPlan) -> str:
-        ids = [str(id(n.batch)) for n in L.collect_nodes(plan, L.Relation)]
-        return plan.tree_string() + "||" + ",".join(ids)
+    def _key(plan: L.LogicalPlan):
+        # injective structural identity incl. leaf batch/source identity
+        return plan.structural_key()
 
     def add(self, plan: L.LogicalPlan) -> None:
         self._entries.setdefault(self._key(plan), [plan, None])
@@ -83,19 +83,22 @@ class CacheManager:
         self._entries.clear()
 
     def apply(self, plan: L.LogicalPlan, run) -> L.LogicalPlan:
-        """Substitute cached subtrees (materializing on first use)."""
+        """Substitute cached subtrees, LARGEST first (top-down — the
+        reference CacheManager matches outermost plans first so a cached
+        derived plan hits even when its own subtree is also cached)."""
         if not self._entries:
             return plan
 
-        def fn(node: L.LogicalPlan) -> L.LogicalPlan:
+        def go(node: L.LogicalPlan) -> L.LogicalPlan:
             entry = self._entries.get(self._key(node))
-            if entry is None:
-                return node
-            if entry[1] is None:
-                entry[1] = L.Relation(run(entry[0]))
-            return entry[1]
+            if entry is not None:
+                if entry[1] is None:
+                    entry[1] = L.Relation(run(entry[0]))
+                return entry[1]
+            children = tuple(go(c) for c in node.children())
+            return node.with_children(children) if children else node
 
-        return plan.transform_up(fn)
+        return go(plan)
 
 
 class Catalog:

@@ -1,0 +1,15 @@
+"""Graph processing — the GraphX/Pregel subset (reference:
+graphx/src/main/scala/org/apache/spark/graphx/Pregel.scala:59,
+impl/GraphImpl.scala).
+
+TPU-first redesign: the reference iterates RDD joins per superstep
+(vertex-program / sendMsg / mergeMsg as three shuffles per round). Here
+a graph is dense device arrays (edges pre-sorted by destination once),
+and a whole Pregel run is ONE jitted program: `lax.fori_loop` over
+supersteps, each being gather(src state) -> edge message -> segmented
+merge by destination (cumsum/scan kernels — scatter-free) -> vertex
+update. No shuffles, no per-round dispatch."""
+
+from spark_tpu.graph.pregel import Graph
+
+__all__ = ["Graph"]

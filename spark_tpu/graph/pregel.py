@@ -1,0 +1,151 @@
+"""Pregel on dense device arrays (reference: graphx/Pregel.scala:59
+`apply` — initial msg, vprog/sendMsg/mergeMsg loop with active-set
+tracking; PageRank.scala, ConnectedComponents.scala).
+
+Messages aggregate per destination with the sorted-segment kernels
+(edges are sorted by dst at construction — the one-time analogue of
+GraphX's routing tables), so every superstep is gathers + cumsum-style
+scans: no scatter, no host syncs, and `lax.fori_loop` keeps the entire
+run inside one XLA program."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_tpu.physical import kernels as K
+
+
+class Graph:
+    """Vertices are arbitrary int64 ids (densified host-side once);
+    edges are (src, dst[, weight])."""
+
+    def __init__(self, vertex_ids, edge_src, edge_dst, edge_attr=None):
+        vid = np.asarray(vertex_ids, dtype=np.int64)
+        order = np.argsort(vid, kind="stable")
+        self.vertex_ids = vid[order]
+        self.n = int(vid.shape[0])
+        es = np.asarray(edge_src)
+        ed = np.asarray(edge_dst)
+        src = np.searchsorted(self.vertex_ids, es)
+        dst = np.searchsorted(self.vertex_ids, ed)
+        for idx, vals, side in ((src, es, "src"), (dst, ed, "dst")):
+            bad = (idx >= self.n) | (self.vertex_ids[
+                np.clip(idx, 0, self.n - 1)] != vals)
+            if bad.any():
+                raise ValueError(
+                    f"edge {side} references unknown vertex ids: "
+                    f"{np.unique(vals[bad])[:5].tolist()}")
+        # sort edges by destination ONCE: message merge becomes a
+        # monotone-segment reduction (kernels.seg_* sorted path)
+        eorder = np.argsort(dst, kind="stable")
+        self.src = jnp.asarray(src[eorder])
+        self.dst = jnp.asarray(dst[eorder])
+        self.edge_attr = (None if edge_attr is None
+                          else jnp.asarray(np.asarray(edge_attr)[eorder]))
+        self.m = int(self.src.shape[0])
+        self.out_degree = jnp.zeros((self.n,), jnp.int32).at[self.src].add(1)
+        self.in_degree = jnp.zeros((self.n,), jnp.int32).at[self.dst].add(1)
+
+    # -- the core loop --------------------------------------------------------
+
+    def pregel(self, init_state: jnp.ndarray,
+               message: Callable,
+               update: Callable,
+               num_iters: int,
+               merge: str = "sum",
+               default_msg=0.0):
+        """Run ``num_iters`` supersteps:
+
+            msgs      = message(src_state, edge_attr)      # (m,)
+            merged[v] = merge(msgs to v)  or default_msg if none
+            state     = update(state, merged)
+
+        merge: 'sum' | 'min' | 'max'. The whole loop compiles to one XLA
+        program (reference peer: Pregel.scala:115's while loop of joins)."""
+        dst = self.dst
+        red = {"sum": K.seg_sum, "min": K.seg_min, "max": K.seg_max}[merge]
+        has_in = self.in_degree > 0
+        m_mask = jnp.ones((self.m,), jnp.bool_)
+
+        def step(_, state):
+            sstate = state[self.src]
+            msgs = message(sstate, self.edge_attr)
+            merged = red(msgs, dst.astype(jnp.int32), m_mask, self.n,
+                         sorted_seg=True)
+            merged = jnp.where(
+                has_in, merged,
+                jnp.asarray(default_msg, dtype=merged.dtype))
+            return update(state, merged)
+
+        return jax.lax.fori_loop(0, num_iters, step, init_state)
+
+    # -- library algorithms (reference: graphx/lib/) --------------------------
+
+    def pagerank(self, num_iters: int = 20,
+                 reset_prob: float = 0.15) -> jnp.ndarray:
+        """reference: graphx/lib/PageRank.scala `run` — contribution =
+        rank/outDegree along each edge, rank = reset + (1-reset)*sum."""
+        deg = jnp.maximum(self.out_degree, 1).astype(jnp.float32)
+
+        def message(src_rank, _):
+            return src_rank / deg[self.src]
+
+        def update(rank, contrib):
+            return reset_prob + (1.0 - reset_prob) * contrib
+
+        init = jnp.full((self.n,), 1.0, jnp.float32)
+        return self.pregel(init, message, update, num_iters,
+                           merge="sum", default_msg=0.0)
+
+    def connected_components(self,
+                             num_iters: Optional[int] = None) -> np.ndarray:
+        """Min-label propagation over the UNDIRECTED graph (reference:
+        graphx/lib/ConnectedComponents.scala). Returns, per vertex, the
+        minimum original vertex id of its component."""
+        both_src = jnp.concatenate([self.src, self.dst])
+        both_dst = jnp.concatenate([self.dst, self.src])
+        order = jnp.argsort(both_dst, stable=True)
+        src = both_src[order]
+        dst = both_dst[order].astype(jnp.int32)
+        m_mask = jnp.ones((src.shape[0],), jnp.bool_)
+        has_in = (jnp.zeros((self.n,), jnp.int32).at[dst].add(1)) > 0
+        big = jnp.iinfo(jnp.int64).max
+
+        def step(_, labels):
+            msgs = labels[src]
+            merged = K.seg_min(msgs, dst, m_mask, self.n, sorted_seg=True)
+            merged = jnp.where(has_in, merged, big)
+            return jnp.minimum(labels, merged)
+
+        labels = jnp.asarray(self.vertex_ids)
+        if num_iters is not None:
+            return np.asarray(jax.lax.fori_loop(0, num_iters, step,
+                                                labels))
+        # default: blocks of supersteps until a fixpoint (the reference
+        # Pregel loop stops when no messages remain) — diameter-bound
+        # instead of O(n) rounds
+        block = 8
+        run_block = jax.jit(
+            lambda l: jax.lax.fori_loop(0, block, step, l))
+        for _ in range(0, max(2, self.n), block):
+            new_labels = run_block(labels)
+            if bool(jnp.all(new_labels == labels)):
+                break
+            labels = new_labels
+        return np.asarray(labels)
+
+    def triangle_count(self) -> int:
+        """Total triangles via dense adjacency matmul (MXU-native for
+        graphs small enough to densify; reference:
+        graphx/lib/TriangleCount.scala counts via neighbor-set
+        intersection). trace(A^3)/6 over the undirected simple graph."""
+        a = jnp.zeros((self.n, self.n), jnp.float32)
+        a = a.at[self.src, self.dst].set(1.0)
+        a = a.at[self.dst, self.src].set(1.0)
+        a = a * (1.0 - jnp.eye(self.n))
+        a3 = a @ a @ a
+        return int(jnp.trace(a3) / 6.0)

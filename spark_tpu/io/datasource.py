@@ -144,6 +144,7 @@ class FileSource:
         self.options = dict(options or {})
         self._dataset: Optional[pads.Dataset] = None
         self._cache: Dict[tuple, Batch] = {}
+        self._count_cache: Dict[tuple, int] = {}
 
     # -- dataset / schema ----------------------------------------------------
 
@@ -224,8 +225,14 @@ class FileSource:
 
     def count_rows(self, filters: Tuple[E.Expression, ...] = ()) -> int:
         """Row count without materializing (drives the out-of-HBM
-        chunking decision)."""
-        return self._open().count_rows(filter=_filters_to_pads(filters))
+        chunking decision). Memoized per filter set — the decision runs
+        on every execution of an aggregate-over-scan query."""
+        key = tuple(E.expr_key(f) for f in filters)
+        hit = self._count_cache.get(key)
+        if hit is None:
+            hit = self._open().count_rows(filter=_filters_to_pads(filters))
+            self._count_cache[key] = hit
+        return hit
 
     def iter_batches(self, columns: Optional[Tuple[str, ...]] = None,
                      filters: Tuple[E.Expression, ...] = (),

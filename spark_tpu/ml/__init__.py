@@ -1,0 +1,22 @@
+"""ML pipelines — the spark.ml subset (reference:
+mllib/src/main/scala/org/apache/spark/ml/Pipeline.scala:41,93 —
+Estimator/Transformer/PipelineModel; feature/, regression/,
+classification/, clustering/, evaluation/).
+
+TPU-first: fitting extracts feature columns into one device matrix and
+runs closed-form/iterative solvers as jitted MXU programs
+(normal equations, full-batch GD in `lax.fori_loop`, Lloyd iterations);
+transform() emits ordinary engine expressions or jax UDFs, so model
+application fuses into query stages like any other projection — there is
+no separate "ML runtime" (the reference drives per-row JVM UDFs over
+breeze/BLAS)."""
+
+from spark_tpu.ml.pipeline import Estimator, Model, Pipeline, Transformer
+from spark_tpu.ml.features import StandardScaler, StringIndexer
+from spark_tpu.ml.regression import LinearRegression
+from spark_tpu.ml.classification import LogisticRegression
+from spark_tpu.ml.clustering import KMeans
+
+__all__ = ["Estimator", "Transformer", "Model", "Pipeline",
+           "StandardScaler", "StringIndexer", "LinearRegression",
+           "LogisticRegression", "KMeans"]

@@ -1,0 +1,77 @@
+"""Logistic regression (reference:
+ml/classification/LogisticRegression.scala — LBFGS over breeze; here
+full-batch gradient descent as one jitted `fori_loop` of MXU matmuls)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_tpu.api import functions as F
+from spark_tpu.expr import expressions as E
+from spark_tpu.ml.pipeline import Estimator, Model, features_matrix
+
+
+class LogisticRegression(Estimator):
+    def __init__(self, featuresCols: Sequence[str], labelCol: str,
+                 predictionCol: str = "prediction",
+                 probabilityCol: str = "probability",
+                 maxIter: int = 200, stepSize: float = 0.5,
+                 regParam: float = 0.0):
+        self.features_cols = list(featuresCols)
+        self.label_col = labelCol
+        self.prediction_col = predictionCol
+        self.probability_col = probabilityCol
+        self.max_iter = maxIter
+        self.step = stepSize
+        self.reg = regParam
+
+    def fit(self, df) -> "LogisticRegressionModel":
+        xy = features_matrix(df, self.features_cols + [self.label_col])
+        x, y = xy[:, :-1], xy[:, -1]
+
+        @partial(jax.jit, static_argnums=())
+        def train(x, y):
+            n, d = x.shape
+            ones = jnp.ones((n, 1), x.dtype)
+            xa = jnp.concatenate([x, ones], axis=1)
+
+            def loss(w):
+                z = xa @ w
+                # numerically-stable logistic loss
+                nll = jnp.mean(jnp.logaddexp(0.0, z) - y * z)
+                return nll + self.reg * jnp.sum(w[:-1] ** 2)
+
+            grad = jax.grad(loss)
+
+            def step(_, w):
+                return w - self.step * grad(w)
+
+            return jax.lax.fori_loop(0, self.max_iter, step,
+                                     jnp.zeros((d + 1,), x.dtype))
+
+        w = train(x, y)
+        coef = [float(v) for v in w[:-1]]
+        return LogisticRegressionModel(self, coef, float(w[-1]))
+
+
+class LogisticRegressionModel(Model):
+    def __init__(self, lr: LogisticRegression, coefficients, intercept):
+        self.lr = lr
+        self.coefficients = coefficients
+        self.intercept = intercept
+
+    def transform(self, df):
+        z: E.Expression = E.Literal(self.intercept)
+        for c, w in zip(self.lr.features_cols, self.coefficients):
+            z = z + F.col(c) * float(w)
+        prob = E.Literal(1.0) / (E.Literal(1.0)
+                                 + E.UnaryMath("exp", E.Neg(z)))
+        df = df.withColumn(self.lr.probability_col, prob)
+        pred = E.Case(((E.Cmp(">", E.Col(self.lr.probability_col),
+                              E.Literal(0.5)), E.Literal(1.0)),),
+                      E.Literal(0.0))
+        return df.withColumn(self.lr.prediction_col, pred)

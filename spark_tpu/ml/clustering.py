@@ -1,0 +1,87 @@
+"""KMeans (reference: ml/clustering/KMeans.scala — Lloyd's algorithm;
+here every iteration is an (n,k) distance matmul + masked mean updates,
+all inside one jitted `fori_loop` — the MXU does the assignment step)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_tpu import types as T
+from spark_tpu.api import functions as F
+from spark_tpu.ml.pipeline import Estimator, Model, features_matrix
+
+
+class KMeans(Estimator):
+    def __init__(self, featuresCols: Sequence[str], k: int,
+                 predictionCol: str = "prediction",
+                 maxIter: int = 50, seed: int = 13):
+        self.features_cols = list(featuresCols)
+        self.k = int(k)
+        self.prediction_col = predictionCol
+        self.max_iter = maxIter
+        self.seed = seed
+
+    def fit(self, df) -> "KMeansModel":
+        x = features_matrix(df, self.features_cols)
+        k = self.k
+
+        @jax.jit
+        def lloyd(x, init_idx):
+            centers0 = x[init_idx]
+
+            def assign(centers):
+                # ||x - c||^2 = ||x||^2 - 2 x.c + ||c||^2; the cross
+                # term is the (n,k) MXU matmul
+                cross = x @ centers.T
+                d2 = (jnp.sum(x * x, 1, keepdims=True) - 2.0 * cross
+                      + jnp.sum(centers * centers, 1)[None, :])
+                return jnp.argmin(d2, axis=1)
+
+            def step(_, centers):
+                a = assign(centers)
+                onehot = (a[:, None] == jnp.arange(k)[None, :]).astype(
+                    x.dtype)
+                counts = onehot.sum(0)
+                sums = onehot.T @ x
+                new = sums / jnp.maximum(counts, 1.0)[:, None]
+                return jnp.where((counts > 0)[:, None], new, centers)
+
+            return jax.lax.fori_loop(0, self.max_iter, step, centers0)
+
+        # k-means|| style greedy farthest-point init (reference:
+        # KMeans.scala initKMeansParallel) — random init can drop two
+        # seeds in one blob and converge to a bad local optimum
+        rng = np.random.default_rng(self.seed)
+        xn = np.asarray(x)
+        idxs = [int(rng.integers(0, xn.shape[0]))]
+        d2 = ((xn - xn[idxs[0]]) ** 2).sum(1)
+        for _ in range(1, k):
+            nxt = int(np.argmax(d2))
+            idxs.append(nxt)
+            d2 = np.minimum(d2, ((xn - xn[nxt]) ** 2).sum(1))
+        centers = lloyd(x, jnp.asarray(np.array(idxs)))
+        return KMeansModel(self, np.asarray(centers))
+
+
+class KMeansModel(Model):
+    def __init__(self, km: KMeans, centers: np.ndarray):
+        self.km = km
+        self.centers = centers
+
+    def transform(self, df):
+        centers = jnp.asarray(self.centers)
+
+        @F.udf(returnType=T.INT32)
+        def nearest(*cols):
+            x = jnp.stack([c.astype(jnp.float32) for c in cols], axis=1)
+            cross = x @ centers.T
+            d2 = (jnp.sum(x * x, 1, keepdims=True) - 2.0 * cross
+                  + jnp.sum(centers * centers, 1)[None, :])
+            return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+        return df.withColumn(self.km.prediction_col,
+                             nearest(*self.km.features_cols))

@@ -1,0 +1,74 @@
+"""Estimator / Transformer / Pipeline core (reference:
+ml/Pipeline.scala:41 Estimator.fit, :93 Pipeline.fit —
+stage-by-stage fit-then-transform)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Transformer:
+    def transform(self, df):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    pass
+
+
+class Estimator:
+    def fit(self, df) -> Model:
+        raise NotImplementedError
+
+
+class Pipeline(Estimator):
+    """fit() runs stages in order: estimators fit on the running
+    dataframe and their models transform it for later stages
+    (reference: Pipeline.scala:93)."""
+
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def fit(self, df) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        cur = df
+        for st in self.stages:
+            if isinstance(st, Estimator):
+                model = st.fit(cur)
+                fitted.append(model)
+                cur = model.transform(cur)
+            else:
+                fitted.append(st)
+                cur = st.transform(cur)
+        return PipelineModel(fitted)
+
+
+class PipelineModel(Model):
+    def __init__(self, stages: Sequence[Transformer]):
+        self.stages = list(stages)
+
+    def transform(self, df):
+        for st in self.stages:
+            df = st.transform(df)
+        return df
+
+
+def features_matrix(df, cols: Sequence[str]):
+    """Materialize feature columns as a dense device matrix (live rows
+    compacted) — the input surface every fitter shares. One transfer,
+    then everything is MXU work."""
+    batch = df.select(*cols)._execute()
+    mask = np.asarray(batch.data.row_mask)
+    for name, cd in zip(cols, batch.data.columns):
+        if cd.validity is not None and not np.asarray(
+                cd.validity)[mask].all():
+            raise ValueError(
+                f"feature column {name!r} contains NULLs; drop or "
+                "impute before fitting (reference: spark.ml raises on "
+                "null features too)")
+    arrs = [np.asarray(cd.data)[mask].astype(np.float32)
+            for cd in batch.data.columns]
+    return jnp.asarray(np.stack(arrs, axis=1))

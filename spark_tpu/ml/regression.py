@@ -1,0 +1,55 @@
+"""Linear regression (reference: ml/regression/LinearRegression.scala —
+WLS/normal-equations solver path)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_tpu.api import functions as F
+from spark_tpu.expr import expressions as E
+from spark_tpu.ml.pipeline import Estimator, Model, features_matrix
+
+
+class LinearRegression(Estimator):
+    """Closed-form ridge-regularized normal equations on device — one
+    (d+1)x(d+1) solve after an MXU gram-matrix matmul."""
+
+    def __init__(self, featuresCols: Sequence[str], labelCol: str,
+                 predictionCol: str = "prediction",
+                 regParam: float = 1e-6):
+        self.features_cols = list(featuresCols)
+        self.label_col = labelCol
+        self.prediction_col = predictionCol
+        self.reg = regParam
+
+    def fit(self, df) -> "LinearRegressionModel":
+        xy = features_matrix(df, self.features_cols + [self.label_col])
+        x, y = xy[:, :-1], xy[:, -1]
+
+        @jax.jit
+        def solve(x, y):
+            ones = jnp.ones((x.shape[0], 1), x.dtype)
+            xa = jnp.concatenate([x, ones], axis=1)
+            g = xa.T @ xa + self.reg * jnp.eye(xa.shape[1], dtype=x.dtype)
+            b = xa.T @ y
+            return jnp.linalg.solve(g, b)
+
+        w = solve(x, y)
+        coef = [float(v) for v in w[:-1]]
+        return LinearRegressionModel(self, coef, float(w[-1]))
+
+
+class LinearRegressionModel(Model):
+    def __init__(self, lr: LinearRegression, coefficients, intercept):
+        self.lr = lr
+        self.coefficients = coefficients
+        self.intercept = intercept
+
+    def transform(self, df):
+        e: E.Expression = E.Literal(self.intercept)
+        for c, w in zip(self.lr.features_cols, self.coefficients):
+            e = e + F.col(c) * float(w)
+        return df.withColumn(self.lr.prediction_col, e)

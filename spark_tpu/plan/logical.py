@@ -82,8 +82,29 @@ class LogicalPlan:
     def node_string(self) -> str:
         return type(self).__name__
 
+    def structural_key(self) -> tuple:
+        """Injective structural identity (node_string is a display
+        string and may omit fields — never use it as a cache key)."""
+        parts: list = [type(self).__name__]
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            parts.append(_field_key(v))
+        return tuple(parts)
+
     def __repr__(self):
         return self.tree_string()
+
+
+def _field_key(v):
+    if isinstance(v, LogicalPlan):
+        return v.structural_key()
+    if isinstance(v, E.Expression):
+        return E.expr_key(v)
+    if isinstance(v, tuple):
+        return tuple(_field_key(x) for x in v)
+    if v.__class__.__module__ == "builtins" and not callable(v):
+        return repr(v)
+    return ("obj", id(v))  # sources/batches: identity
 
 
 def _transform_value(v, fn):

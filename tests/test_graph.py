@@ -1,0 +1,85 @@
+"""Pregel/GraphX subset (reference: graphx/Pregel.scala:59,
+lib/PageRank.scala, lib/ConnectedComponents.scala)."""
+
+import numpy as np
+import pytest
+
+from spark_tpu.graph import Graph
+
+
+def test_pagerank_star():
+    # hub 0 pointed at by 1..4: hub rank must dominate
+    g = Graph(vertex_ids=[0, 1, 2, 3, 4],
+              edge_src=[1, 2, 3, 4], edge_dst=[0, 0, 0, 0])
+    pr = np.asarray(g.pagerank(num_iters=30))
+    assert pr[0] > pr[1]
+    assert np.allclose(pr[1:], pr[1])  # leaves symmetric
+    # leaves have no in-edges: rank = reset_prob
+    assert pr[1] == pytest.approx(0.15)
+    assert pr[0] == pytest.approx(0.15 + 0.85 * 4 * 0.15, rel=1e-5)
+
+
+def test_pagerank_cycle_uniform():
+    n = 8
+    g = Graph(vertex_ids=list(range(n)),
+              edge_src=list(range(n)),
+              edge_dst=[(i + 1) % n for i in range(n)])
+    pr = np.asarray(g.pagerank(num_iters=50))
+    assert np.allclose(pr, 1.0, atol=1e-4)  # symmetric cycle: all equal
+
+
+def test_connected_components():
+    # two components {10,11,12} (chain) and {20,21}; singleton {30}
+    g = Graph(vertex_ids=[10, 11, 12, 20, 21, 30],
+              edge_src=[10, 11, 20], edge_dst=[11, 12, 21])
+    labels = g.connected_components()
+    by_id = dict(zip(g.vertex_ids.tolist(), labels.tolist()))
+    assert by_id[10] == by_id[11] == by_id[12] == 10
+    assert by_id[20] == by_id[21] == 20
+    assert by_id[30] == 30
+
+
+def test_connected_components_random():
+    rng = np.random.default_rng(5)
+    # 3 random blobs connected internally by random spanning chains
+    ids, src, dst = [], [], []
+    for b in range(3):
+        nodes = list(range(b * 100, b * 100 + 30))
+        ids.extend(nodes)
+        perm = rng.permutation(nodes)
+        src.extend(perm[:-1])
+        dst.extend(perm[1:])
+    g = Graph(ids, src, dst)
+    labels = g.connected_components()
+    by_id = dict(zip(g.vertex_ids.tolist(), labels.tolist()))
+    for b in range(3):
+        vals = {by_id[v] for v in range(b * 100, b * 100 + 30)}
+        assert vals == {b * 100}
+
+
+def test_custom_pregel_shortest_path():
+    import jax.numpy as jnp
+
+    # single-source shortest path by min-propagation with edge weights
+    g = Graph(vertex_ids=[0, 1, 2, 3],
+              edge_src=[0, 0, 1, 2], edge_dst=[1, 2, 3, 3],
+              edge_attr=[1.0, 4.0, 1.0, 1.0])
+    inf = 1e18
+    init = jnp.asarray([0.0, inf, inf, inf])
+
+    def message(src_dist, w):
+        return src_dist + w
+
+    def update(dist, best_in):
+        return jnp.minimum(dist, best_in)
+
+    out = np.asarray(g.pregel(init, message, update, num_iters=4,
+                              merge="min", default_msg=inf))
+    assert out.tolist() == [0.0, 1.0, 4.0, 2.0]
+
+
+def test_triangle_count():
+    # triangle 0-1-2 plus a dangling edge 2-3
+    g = Graph(vertex_ids=[0, 1, 2, 3],
+              edge_src=[0, 1, 2, 2], edge_dst=[1, 2, 0, 3])
+    assert g.triangle_count() == 1

@@ -1,0 +1,106 @@
+"""ML pipeline subset (reference: ml/Pipeline.scala:93 + feature/
+regression/classification/clustering suites)."""
+
+import numpy as np
+import pytest
+
+from spark_tpu.api import functions as F
+from spark_tpu.ml import (KMeans, LinearRegression, LogisticRegression,
+                          Pipeline, StandardScaler, StringIndexer)
+
+
+@pytest.fixture(scope="module")
+def reg_df(spark):
+    rng = np.random.default_rng(21)
+    n = 2000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n) * 3 + 1
+    y = 2.5 * x1 - 1.25 * x2 + 0.75 + rng.normal(size=n) * 0.01
+    return spark.createDataFrame(
+        [{"x1": float(a), "x2": float(b), "y": float(c)}
+         for a, b, c in zip(x1, x2, y)])
+
+
+def test_linear_regression_recovers_coefficients(reg_df):
+    model = LinearRegression(["x1", "x2"], "y").fit(reg_df)
+    assert model.coefficients[0] == pytest.approx(2.5, abs=0.01)
+    assert model.coefficients[1] == pytest.approx(-1.25, abs=0.01)
+    assert model.intercept == pytest.approx(0.75, abs=0.01)
+    out = model.transform(reg_df)
+    diff = F.col("prediction") - F.col("y")
+    err = out.select((diff * diff).alias("se"))
+    rmse = err.agg(F.avg("se").alias("m")).collect()[0].m ** 0.5
+    assert rmse < 0.05
+
+
+def test_logistic_regression_separates(spark):
+    rng = np.random.default_rng(22)
+    n = 1000
+    x = rng.normal(size=(n, 2))
+    label = (x[:, 0] + 2 * x[:, 1] > 0).astype(float)
+    df = spark.createDataFrame(
+        [{"a": float(r[0]), "b": float(r[1]), "lbl": float(l)}
+         for r, l in zip(x, label)])
+    model = LogisticRegression(["a", "b"], "lbl", maxIter=300).fit(df)
+    out = model.transform(df)
+    acc = out.select(
+        F.when(F.col("prediction") == F.col("lbl"), 1.0)
+        .otherwise(0.0).alias("ok")).agg(F.avg("ok").alias("a")) \
+        .collect()[0].a
+    assert acc > 0.97
+
+
+def test_kmeans_three_blobs(spark):
+    rng = np.random.default_rng(23)
+    centers = np.array([[0.0, 0.0], [10.0, 10.0], [-10.0, 10.0]])
+    pts, true = [], []
+    for i, c in enumerate(centers):
+        blob = rng.normal(size=(150, 2)) * 0.5 + c
+        pts.append(blob)
+        true.extend([i] * 150)
+    pts = np.concatenate(pts)
+    df = spark.createDataFrame(
+        [{"px": float(p[0]), "py": float(p[1]), "t": t}
+         for p, t in zip(pts, true)])
+    model = KMeans(["px", "py"], k=3, maxIter=30).fit(df)
+    out = model.transform(df).collect()
+    # each true blob maps to exactly one predicted cluster
+    mapping = {}
+    for r in out:
+        mapping.setdefault(r.t, set()).add(r.prediction)
+    assert all(len(v) == 1 for v in mapping.values())
+    assert len({next(iter(v)) for v in mapping.values()}) == 3
+
+
+def test_pipeline_scaler_indexer_lr(spark):
+    rng = np.random.default_rng(24)
+    n = 600
+    x = rng.normal(size=n) * 7 + 3
+    cat = rng.choice(["red", "green", "blue"], size=n,
+                     p=[0.5, 0.3, 0.2])
+    y = 3 * ((x - 3) / 7) + (cat == "red") * 2.0 + 0.5
+    df = spark.createDataFrame(
+        [{"x": float(a), "cat": str(c), "y": float(v)}
+         for a, c, v in zip(x, cat, y)])
+    pipe = Pipeline([
+        StandardScaler(["x"]),
+        StringIndexer("cat"),
+        LinearRegression(["x_scaled", "cat_idx"], "y"),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    se = out.select(((F.col("prediction") - F.col("y"))
+                     * (F.col("prediction") - F.col("y"))).alias("se"))
+    mse = se.agg(F.avg("se").alias("m")).collect()[0].m
+    # cat-idx is only an ordinal encoding, so fit is approximate but
+    # must explain most of the variance
+    assert mse < 1.0
+
+
+def test_string_indexer_frequency_order(spark):
+    df = spark.createDataFrame(
+        [{"c": v} for v in ["b", "a", "b", "b", "a", "c"]])
+    model = StringIndexer("c").fit(df)
+    assert model.labels == ["b", "a", "c"]  # by desc frequency
+    out = {(r.c, r.c_idx) for r in model.transform(df).collect()}
+    assert ("b", 0.0) in out and ("a", 1.0) in out and ("c", 2.0) in out

@@ -1,0 +1,82 @@
+"""pandas-on-spark subset (reference: python/pyspark/pandas/)."""
+
+import pandas as pd
+import pytest
+
+import spark_tpu.pandas as ps
+
+
+@pytest.fixture(scope="module")
+def pdf(spark):
+    data = pd.DataFrame({
+        "k": ["a", "b", "a", "c", "b", "a"],
+        "x": [1, 2, 3, 4, 5, 6],
+        "y": [1.5, 2.5, 3.5, 4.5, 5.5, 6.5],
+    })
+    return data, ps.from_pandas(data)
+
+
+def test_filter_and_select(pdf):
+    data, f = pdf
+    out = f[f.x > 3][["k", "x"]].to_pandas()
+    want = data[data.x > 3][["k", "x"]].reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        out.sort_values("x").reset_index(drop=True),
+        want.sort_values("x").reset_index(drop=True))
+
+
+def test_column_arith_and_assign(pdf):
+    _, f = pdf
+    g = f.assign(z=f.x * 2 + f.y)
+    out = g.to_pandas()
+    assert (out.z == out.x * 2 + out.y).all()
+
+
+def test_groupby_agg(pdf):
+    data, f = pdf
+    out = f.groupby("k").agg({"x": "sum", "y": "mean"}).to_pandas()
+    want = data.groupby("k").agg(x=("x", "sum"), y=("y", "mean")) \
+        .reset_index()
+    pd.testing.assert_frame_equal(
+        out.sort_values("k").reset_index(drop=True),
+        want.sort_values("k").reset_index(drop=True))
+
+
+def test_groupby_count_sum(pdf):
+    data, f = pdf
+    out = f.groupby("k").count().to_pandas()
+    want = data.groupby("k").size()
+    got = dict(zip(out.k, out["count"]))
+    assert got == want.to_dict()
+
+
+def test_merge(pdf):
+    _, f = pdf
+    dim = ps.from_pandas(pd.DataFrame(
+        {"k": ["a", "b", "c"], "w": [10, 20, 30]}))
+    out = f.merge(dim, on="k").to_pandas()
+    assert len(out) == 6
+    assert set(out.columns) >= {"k", "x", "y", "w"}
+    assert (out[out.k == "a"].w == 10).all()
+
+
+def test_reductions(pdf):
+    data, f = pdf
+    assert f.x.sum() == data.x.sum()
+    assert f.y.mean() == pytest.approx(data.y.mean())
+    assert f.x.max() == 6 and f.x.min() == 1
+    assert f.k.nunique() == 3
+
+
+def test_sort_head_len(pdf):
+    data, f = pdf
+    assert len(f) == 6
+    top = f.sort_values("x", ascending=False).head(2)
+    assert top.x.tolist() == [6, 5]
+
+
+def test_describe(pdf):
+    _, f = pdf
+    d = f.describe()
+    assert d.loc["count", "x"] == 6
+    assert d.loc["max", "y"] == 6.5
