@@ -62,8 +62,8 @@ class CacheManager:
     InMemoryRelation): cache() registers the logical plan; the first
     execution materializes it to a device Batch, and every later query
     whose tree contains a cached subplan scans the materialized batch
-    instead of recomputing. Identity is structural (tree_string) plus
-    leaf-batch identity."""
+    instead of recomputing. Identity is structural_key() — injective
+    plan structure plus leaf batch/source identity."""
 
     def __init__(self):
         self._entries: Dict[str, list] = {}
@@ -116,11 +116,57 @@ class Catalog:
     def lookup(self, name: str) -> L.LogicalPlan:
         key = name.lower()
         if key not in self._views:
-            raise KeyError(f"table or view not found: {name}")
+            plan = self._load_persistent(key)
+            if plan is None:
+                raise KeyError(f"table or view not found: {name}")
+            return plan
         return self._views[key]
 
+    def _warehouse(self) -> str:
+        from spark_tpu import conf as CF
+
+        return self._session.conf.get(CF.WAREHOUSE_DIR)
+
+    def _load_persistent(self, key: str):
+        """Persistent (saveAsTable) tier: tables live as
+        <warehouse>/<name>/{_table.json,data/} and survive sessions
+        (reference: SessionCatalog external-catalog lookup)."""
+        import json
+        import os
+
+        meta_path = os.path.join(self._warehouse(), key, "_table.json")
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            meta = json.load(f)
+        from spark_tpu.io.datasource import FileSource
+
+        options = dict(meta.get("options") or {})
+        if meta.get("partition_by"):
+            # partition columns live in hive directory names
+            options["partitioning"] = "hive"
+        src = FileSource(meta.get("format", "parquet"),
+                         [os.path.join(self._warehouse(), key, "data")],
+                         options=options)
+        plan = L.UnresolvedScan(src)
+        self._views[key] = plan  # memoize for the session
+        return plan
+
+    def refresh_persistent(self, key: str) -> None:
+        """Drop any memoized plan so the next lookup re-reads the
+        (re)written table."""
+        self._views.pop(key, None)
+
     def listTables(self) -> List[str]:
-        return sorted(self._views)
+        import os
+
+        names = set(self._views)
+        wh = self._warehouse()
+        if os.path.isdir(wh):
+            for d in os.listdir(wh):
+                if os.path.exists(os.path.join(wh, d, "_table.json")):
+                    names.add(d)
+        return sorted(names)
 
     def dropTempView(self, name: str) -> bool:
         return self._views.pop(name.lower(), None) is not None

@@ -56,6 +56,11 @@ REPARTITION_SLACK = register(
     "Per-destination capacity slack factor for hash repartition "
     "(all_to_all requires static per-pair sizes).", int)
 
+WAREHOUSE_DIR = register(
+    "spark.sql.warehouse.dir", "spark-warehouse",
+    "Directory for persistent (saveAsTable) tables (reference: "
+    "StaticSQLConf WAREHOUSE_PATH).", str)
+
 EVENT_LOG_DIR = register(
     "spark.eventLog.dir", "",
     "When set, per-stage execution events are appended as JSONL under "

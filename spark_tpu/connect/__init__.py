@@ -1,0 +1,20 @@
+"""Connect server — the Spark Connect / thriftserver analogue
+(reference: connector/connect/.../service/SparkConnectService.scala,
+sql/hive-thriftserver/.../SparkExecuteStatementOperation.scala).
+
+The reference speaks gRPC+protobuf (Connect) or the HiveServer2 thrift
+protocol; both ultimately execute SQL and stream Arrow batches back.
+Here the wire is plain HTTP + Arrow IPC streams — no JVM, no thrift,
+and any language with an HTTP client and an Arrow reader can talk to
+the TPU engine:
+
+    POST /sql  {"query": "select ..."}  ->  arrow IPC stream
+    GET  /tables                        ->  JSON list
+
+Server: `spark_tpu.connect.serve(spark, port)`. Client:
+`spark_tpu.connect.Client("http://host:port").sql("...")` -> pyarrow
+Table."""
+
+from spark_tpu.connect.server import Client, ConnectServer, serve
+
+__all__ = ["ConnectServer", "Client", "serve"]

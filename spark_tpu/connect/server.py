@@ -1,0 +1,119 @@
+"""HTTP + Arrow-IPC result server (reference:
+SparkConnectService.scala — ExecutePlan returning Arrow batches;
+SparkExecuteStatementOperation.scala for the SQL-string entry)."""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import pyarrow as pa
+
+
+class ConnectServer:
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        #: the engine session is not thread-safe (LRU caches, catalog,
+        #: conf) — queries execute serially, handlers stay concurrent
+        #: for health/metadata (reference: thriftserver runs statements
+        #: on a session-scoped executor too)
+        self._exec_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/tables":
+                    body = json.dumps(
+                        outer.session.catalog.listTables()).encode()
+                    self._send(200, body, "application/json")
+                elif self.path == "/health":
+                    self._send(200, b"ok", "text/plain")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                if self.path != "/sql":
+                    self._send(404, b"not found", "text/plain")
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    req = json.loads(self.rfile.read(n))
+                    with outer._exec_lock:
+                        tbl = outer.session.sql(req["query"]).toArrow()
+                    sink = io.BytesIO()
+                    with pa.ipc.new_stream(sink, tbl.schema) as w:
+                        w.write_table(tbl)
+                    self._send(200, sink.getvalue(),
+                               "application/vnd.apache.arrow.stream")
+                except Exception as e:  # error -> JSON with message
+                    body = json.dumps(
+                        {"error": type(e).__name__,
+                         "message": str(e)}).encode()
+                    self._send(400, body, "application/json")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ConnectServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def serve(session, host: str = "127.0.0.1",
+          port: int = 15002) -> ConnectServer:
+    """Start the server (default port mirrors Spark Connect's 15002)."""
+    return ConnectServer(session, host, port).start()
+
+
+class Client:
+    """Minimal client: sql() -> pyarrow.Table (reference client surface:
+    pyspark.sql.connect.session.SparkSession.sql)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def sql(self, query: str) -> pa.Table:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + "/sql",
+            data=json.dumps({"query": query}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = json.loads(e.read())
+            raise RuntimeError(
+                f"{detail.get('error')}: {detail.get('message')}") from None
+        return pa.ipc.open_stream(io.BytesIO(data)).read_all()
+
+    def tables(self):
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/tables") as resp:
+            return json.loads(resp.read())

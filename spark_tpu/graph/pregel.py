@@ -27,6 +27,12 @@ class Graph:
         vid = np.asarray(vertex_ids, dtype=np.int64)
         order = np.argsort(vid, kind="stable")
         self.vertex_ids = vid[order]
+        if self.vertex_ids.size and (
+                self.vertex_ids[1:] == self.vertex_ids[:-1]).any():
+            dup = self.vertex_ids[1:][self.vertex_ids[1:]
+                                      == self.vertex_ids[:-1]]
+            raise ValueError(
+                f"duplicate vertex ids: {np.unique(dup)[:5].tolist()}")
         self.n = int(vid.shape[0])
         es = np.asarray(edge_src)
         ed = np.asarray(edge_dst)

@@ -181,8 +181,12 @@ class FileSource:
                 convert_options=pacsv.ConvertOptions(**convert)
                 if convert else None)
             kwargs["format"] = fmt
+            if str(self.options.get("partitioning", "")) == "hive":
+                kwargs["partitioning"] = "hive"
         elif self.fmt == "json":
             kwargs["format"] = "json"
+            if str(self.options.get("partitioning", "")) == "hive":
+                kwargs["partitioning"] = "hive"
         else:
             raise ValueError(f"unsupported format {self.fmt!r}")
         if self._schema is not None and self.fmt == "parquet":

@@ -140,6 +140,28 @@ class DataFrameWriter:
         table = self._df.toArrow()
         self._write_table(table, path)
 
+    def saveAsTable(self, name: str) -> None:
+        """Persistent table under spark.sql.warehouse.dir (reference:
+        DataFrameWriter.saveAsTable + the session catalog's persistent
+        tier, SessionCatalog.scala:61): data as parquet + a metadata
+        JSON recording the format, re-registered on lookup by any later
+        session pointing at the same warehouse."""
+        import json
+
+        from spark_tpu import conf as CF
+
+        session = self._df._session
+        wh = session.conf.get(CF.WAREHOUSE_DIR)
+        os.makedirs(wh, exist_ok=True)
+        path = os.path.join(wh, name.lower())
+        self.save(os.path.join(path, "data"))
+        meta = {"name": name.lower(), "format": self._format,
+                "partition_by": self._partition_by,
+                "options": {k: str(v) for k, v in self._options.items()}}
+        with open(os.path.join(path, "_table.json"), "w") as f:
+            json.dump(meta, f)
+        session.catalog.refresh_persistent(name.lower())
+
     def _write_table(self, table: pa.Table, path: str) -> None:
         import pyarrow.dataset as pads
 
@@ -184,7 +206,4 @@ class DataFrameWriter:
     def json(self, path: str, mode: Optional[str] = None) -> None:
         self.save(path, format="json", mode=mode)
 
-    def saveAsTable(self, name: str) -> None:
-        """Register the materialized result in the session catalog."""
-        df = self._df
-        df._session.catalog._register_view(name, L.Relation(df._execute()))
+

@@ -241,11 +241,12 @@ class PsFrame:
         df = self._df
         cols = [f.name for f in df.schema.fields
                 if not isinstance(f.dtype, (T.StringType, T.DateType))]
-        stats = []
-        for how in ("count", "mean", "std", "min", "max"):
-            aggs = [_AGG_FNS[how](c).alias(c) for c in cols]
-            row = df.agg(*aggs).collect()[0].asDict()
-            stats.append(dict(row, statistic=how))
+        hows = ("count", "mean", "std", "min", "max")
+        aggs = [_AGG_FNS[how](c).alias(f"{how}__{c}")
+                for how in hows for c in cols]
+        row = df.agg(*aggs).collect()[0].asDict()  # ONE execution
+        stats = [dict({c: row[f"{how}__{c}"] for c in cols},
+                      statistic=how) for how in hows]
         import pandas as pd
 
         return pd.DataFrame(stats).set_index("statistic")

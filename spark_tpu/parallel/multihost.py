@@ -1,0 +1,79 @@
+"""Multi-host execution: the DCN tier (reference:
+core/.../scheduler/cluster/CoarseGrainedSchedulerBackend.scala:53 driver
+RPC loop + executor registration, HeartbeatReceiver.scala:67).
+
+TPU-first replacement: there is no driver/executor RPC protocol to
+build — `jax.distributed` IS the control plane (a coordination service
+every host connects to), and once initialized, `jax.devices()` spans all
+hosts so the SAME MeshExecutor programs run SPMD across the pod:
+intra-slice exchanges ride ICI, cross-slice collectives ride DCN, and
+XLA partitions every stage program automatically. "Task launch" on N
+hosts is N processes dispatching the same jitted stage; the coordination
+service supplies barriers, health, and failure propagation (a dead host
+fails the collective -> every host sees the error -> the driver restarts
+from the last completed stage, the lineage-recompute analogue).
+
+What each host runs:
+
+    from spark_tpu.parallel.multihost import initialize, global_mesh
+    initialize(coordinator="host0:8476", num_processes=N, process_id=i)
+    spark = SparkSession.builder.master("mesh[*]").getOrCreate()
+    # identical driver code on every host; collect() returns on host 0
+
+This module is deliberately thin: everything mesh-shaped in the engine
+(exchange collectives, stage programs, shard layouts) is already
+host-count agnostic — the ShardedBatch axis simply spans more devices.
+Single-host CI exercises the same code paths through the virtual-device
+mesh (tests/conftest.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the jax.distributed coordination service (reference peer:
+    CoarseGrainedExecutorBackend registering with the driver). On
+    single-host setups this is a no-op; on TPU pods with autodetection
+    all arguments may be None."""
+    if num_processes is not None and int(num_processes) <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+    except (RuntimeError, ValueError) as e:
+        if coordinator is None and num_processes is None:
+            # bare call outside a managed multi-host environment
+            # (autodetection needs a TPU pod / cluster): single host
+            return
+        raise e
+
+
+def global_mesh(devices: Optional[Sequence] = None):
+    """A data mesh over EVERY device in the job (all hosts). Shardings
+    placed on this mesh make XLA route intra-host traffic over ICI and
+    inter-host traffic over DCN without any engine changes."""
+    from spark_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=list(devices) if devices is not None
+                     else list(jax.devices()))
+
+
+def process_info() -> dict:
+    """Host-level topology facts (the executor-registration record)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+        "global_devices": len(jax.devices()),
+    }
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

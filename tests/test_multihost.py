@@ -1,0 +1,23 @@
+"""Multi-host scaffolding (single-process coverage: the mesh paths are
+host-count agnostic, so CI exercises them through virtual devices)."""
+
+from spark_tpu.parallel import multihost
+
+
+def test_process_info_single_host():
+    info = multihost.process_info()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["global_devices"] >= 1
+    assert multihost.is_coordinator()
+
+
+def test_initialize_single_process_noop():
+    multihost.initialize(num_processes=1, process_id=0)  # must not raise
+
+
+def test_global_mesh_spans_devices(spark):
+    mesh = multihost.global_mesh()
+    import jax
+
+    assert mesh.devices.size == len(jax.devices())
