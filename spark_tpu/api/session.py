@@ -28,6 +28,16 @@ def _enable_compilation_cache() -> None:
     CodeGenerator.scala:1442 'cache')."""
     import os
 
+    if os.environ.get("SPARK_TPU_JAX_CACHE") in ("0", "off"):
+        # XLA:CPU AOT (de)serialization is not reliable on this host
+        # class (observed: SIGSEGV in deserialize_executable and SIGABRT
+        # in serialize_executable deep into long multi-hundred-compile
+        # processes, always via the persistent cache paths; plus E-level
+        # 'machine feature +prefer-no-scatter not supported' loader
+        # warnings on every hit). The test suite opts out; normal
+        # sessions and the TPU bench keep the disk cache.
+        return
+
     try:
         platform = jax.default_backend()
     except Exception:
@@ -55,6 +65,50 @@ def _enable_compilation_cache() -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass  # older jax without these flags: in-memory caching only
+    _harden_cache_writes()
+
+
+def _harden_cache_writes() -> None:
+    """Make persistent-cache entry writes atomic. jax's LRUCache.put
+    writes entries with a bare ``Path.write_bytes`` (lru_cache.py:152) —
+    a process killed mid-write leaves a TRUNCATED serialized executable,
+    and every later process SIGSEGVs inside
+    ``backend.deserialize_executable`` when it reads the entry (observed:
+    full-suite segfaults after a timeout-killed run poisoned the cache).
+    Wrap put() so entry files land via write-temp + os.replace."""
+    try:
+        from jax._src import lru_cache as _lru
+    except Exception:
+        return
+    if getattr(_lru.LRUCache.put, "_spark_tpu_atomic", False):
+        return
+    orig = _lru.LRUCache.put
+    suffix = getattr(_lru, "_CACHE_SUFFIX", None)
+    if suffix is None:
+        return  # unknown layout: leave jax untouched
+
+    def put(self, key, val, _orig=orig, _suffix=suffix):
+        # Pre-create the entry file ATOMICALLY (temp + rename), then let
+        # the original put run: it sees the entry exists and returns,
+        # still doing its own locking/eviction bookkeeping. No global
+        # state is patched, so concurrent writers are unaffected.
+        import os
+        import threading
+
+        try:
+            if key:
+                cache_path = self.path / f"{key}{_suffix}"
+                tmp = cache_path.with_name(
+                    f"{cache_path.name}.tmp{os.getpid()}-"
+                    f"{threading.get_ident()}")
+                tmp.write_bytes(val)
+                os.replace(tmp, cache_path)
+        except OSError:
+            pass  # fall through: original non-atomic path still works
+        return _orig(self, key, val)
+
+    put._spark_tpu_atomic = True
+    _lru.LRUCache.put = put
 
 
 class CacheManager:
@@ -179,9 +233,16 @@ class SparkSessionBuilder:
     def __init__(self):
         self._conf: Dict[str, Any] = {}
         self._app_name = "spark-tpu"
+        self._ext_fns: list = []
 
     def appName(self, name: str) -> "SparkSessionBuilder":
         self._app_name = name
+        return self
+
+    def withExtensions(self, fn) -> "SparkSessionBuilder":
+        """fn(extensions) registers injection points at session build
+        (reference: SparkSession.Builder.withExtensions)."""
+        self._ext_fns.append(fn)
         return self
 
     def master(self, url: str) -> "SparkSessionBuilder":
@@ -206,6 +267,9 @@ class SparkSessionBuilder:
         else:
             for k, v in self._conf.items():
                 SparkSession._active.conf.set(k, v)
+        for fn in self._ext_fns:
+            fn(SparkSession._active.extensions)
+        self._ext_fns = []
         return SparkSession._active
 
 
@@ -223,6 +287,10 @@ class SparkSession:
         self.conf = RuntimeConf(conf)
         self.catalog = Catalog(self)
         self.cache_manager = CacheManager()
+        self._stopped = False
+        from spark_tpu.extensions import Extensions
+
+        self.extensions = Extensions()
         self._read = None
         self._mesh = None
         self._mesh_executor = None
@@ -231,6 +299,8 @@ class SparkSession:
             from spark_tpu.parallel.mesh import make_mesh
 
             self._mesh = make_mesh(None if n == -1 else int(n))
+        # last: plugins may exercise any session API from init(session)
+        self.extensions.load_plugins(self)
 
     @property
     def mesh_executor(self):
@@ -248,6 +318,33 @@ class SparkSession:
     def _reset(cls):
         cls._active = None
         cls.builder = SparkSessionBuilder()
+
+    @classmethod
+    def getActiveSession(cls) -> Optional["SparkSession"]:
+        """Reference: SparkSession.getActiveSession."""
+        return cls._active
+
+    @classmethod
+    def setActiveSession(cls, session: "SparkSession") -> None:
+        cls._active = session
+
+    def _ensure_active(self) -> None:
+        """Make this session the process-current one if none is (global
+        lookups — injected functions/rules, conf-driven optimizer flags —
+        resolve against the active session; a session that is executing
+        a query is by definition current). A stop()ed session never
+        resurrects itself: getOrCreate() must build a fresh one."""
+        if SparkSession._active is None and not self._stopped:
+            SparkSession._active = self
+
+    @property
+    def sparkContext(self):
+        """RDD-tier entry point (reference: SparkContext.scala:85)."""
+        if getattr(self, "_sc", None) is None:
+            from spark_tpu.rdd import SparkContext
+
+            self._sc = SparkContext(self)
+        return self._sc
 
     @property
     def read(self):
@@ -273,7 +370,9 @@ class SparkSession:
     def sql(self, query: str) -> DataFrame:
         from spark_tpu.sql.parser import parse_sql
 
-        plan = parse_sql(query, self.catalog)
+        self._ensure_active()
+        # injected parser hooks first (injectParser:318 analogue)
+        plan = self.extensions.parse(query, self.catalog, parse_sql)
         return DataFrame(self, plan)
 
     def createDataFrame(
@@ -315,6 +414,8 @@ class SparkSession:
         return df
 
     def stop(self) -> None:
+        self._stopped = True
+        self.extensions.shutdown_plugins()
         SparkSession._reset()
 
     @property
