@@ -61,6 +61,12 @@ WAREHOUSE_DIR = register(
     "Directory for persistent (saveAsTable) tables (reference: "
     "StaticSQLConf WAREHOUSE_PATH).", str)
 
+CBO_JOIN_REORDER = register(
+    "spark.sql.cbo.joinReorder.enabled", True,
+    "Reorder maximal inner equi-join clusters greedily by estimated "
+    "cardinality (reference: CostBasedJoinReorder.scala:1; here driven "
+    "by batch capacities and Parquet metadata, not ANALYZE stats).", bool)
+
 EVENT_LOG_DIR = register(
     "spark.eventLog.dir", "",
     "When set, per-stage execution events are appended as JSONL under "

@@ -1,0 +1,214 @@
+"""Cost-based join reordering.
+
+Analogue of the reference's CostBasedJoinReorder (reference:
+sql/catalyst/.../optimizer/CostBasedJoinReorder.scala:1 — a DP over join
+orders driven by ANALYZE-collected statistics) and the size-estimation
+side of JoinSelectionHelper. The TPU build has no persisted statistics;
+instead it estimates cardinalities directly from the physical substrate
+(device batch capacities, Parquet row-group metadata via
+``FileSource.count_rows`` — exact and memoized for pushed filters) and
+greedily builds a left-deep order that keeps intermediate results small.
+Greedy-smallest-next rather than full DP: TPC-H-class plans have <=8
+relations and star/snowflake shapes where greedy and DP agree, and the
+estimator's error bars don't justify an exponential search.
+
+Scope guard: only maximal clusters of INNER equi-joins are reordered,
+and only when every column name in the cluster is globally unique (so
+key/condition expressions keep meaning under any order; '#2' dedup
+renames would otherwise shift). Residual non-equi conditions are applied
+as a Filter above the reordered cluster — equivalent for inner joins.
+The cluster's output column order is restored with a Project so parents
+observe an identical schema.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+
+
+# ---- cardinality estimation -------------------------------------------------
+
+
+def _filter_selectivity(cond: E.Expression) -> float:
+    """Per-conjunct heuristic (reference: FilterEstimation.scala defaults
+    collapsed to: equality selects less than a range predicate)."""
+    from spark_tpu.plan.optimizer import split_conjuncts
+
+    sel = 1.0
+    for c in split_conjuncts(cond):
+        if isinstance(c, E.Cmp) and c.op == "==":
+            sel *= 0.1
+        elif isinstance(c, (E.In, E.Like)):
+            sel *= 0.2
+        else:
+            sel *= 0.4
+    return max(sel, 1e-4)
+
+
+def estimate_rows(plan: L.LogicalPlan) -> float:
+    """Output cardinality estimate. Exact at leaves (batch capacities,
+    file metadata + pushed-filter counts), heuristic above them
+    (reference: statsEstimation/{SizeInBytesOnlyStatsPlanVisitor,
+    FilterEstimation,JoinEstimation}.scala)."""
+    if isinstance(plan, L.Relation):
+        return float(plan.batch.capacity)
+    if isinstance(plan, L.UnresolvedScan):
+        try:
+            # exact: Parquet metadata (+ memoized filtered count when
+            # predicates were pushed into the scan)
+            return float(plan.source.count_rows(plan.filters))
+        except Exception:
+            sel = 1.0
+            for f in plan.filters:
+                sel *= _filter_selectivity(f)
+            return 1e6 * sel
+    if isinstance(plan, L.Range):
+        return float(plan.num_rows)
+    if isinstance(plan, L.Filter):
+        return max(1.0, estimate_rows(plan.child)
+                   * _filter_selectivity(plan.condition))
+    if isinstance(plan, L.Limit):
+        return min(float(plan.n), estimate_rows(plan.child))
+    if isinstance(plan, L.Sample):
+        return estimate_rows(plan.child) * plan.fraction
+    if isinstance(plan, L.Aggregate):
+        child = estimate_rows(plan.child)
+        if not plan.groupings:
+            return 1.0
+        return max(1.0, child ** 0.75)
+    if isinstance(plan, L.Distinct):
+        return max(1.0, estimate_rows(plan.child) ** 0.9)
+    if isinstance(plan, L.Join):
+        l = estimate_rows(plan.left)
+        r = estimate_rows(plan.right)
+        if plan.how == "cross" and not plan.left_keys:
+            return l * r
+        if plan.how in ("left_semi", "left_anti"):
+            return max(1.0, l * 0.5)
+        # PK-FK assumption for equi joins: one side's keys are ~unique
+        return max(l, r)
+    if isinstance(plan, L.Union):
+        return sum(estimate_rows(c) for c in plan.children())
+    children = plan.children()
+    if len(children) == 1:
+        return estimate_rows(children[0])
+    return max((estimate_rows(c) for c in children), default=1.0)
+
+
+# ---- cluster flattening -----------------------------------------------------
+
+
+def _flatten(node: L.LogicalPlan, atoms: List[L.LogicalPlan],
+             key_pairs: List[Tuple[E.Expression, E.Expression]],
+             conds: List[E.Expression]) -> bool:
+    """Flatten a maximal inner-equi-join subtree. Returns False when the
+    cluster shape is out of scope (a keyless theta join would otherwise
+    be turned into a cartesian product)."""
+    if isinstance(node, L.Join) and node.how == "inner":
+        if not node.left_keys:
+            return False
+        if not _flatten(node.left, atoms, key_pairs, conds):
+            return False
+        if not _flatten(node.right, atoms, key_pairs, conds):
+            return False
+        key_pairs.extend(zip(node.left_keys, node.right_keys))
+        if node.condition is not None:
+            conds.append(node.condition)
+        return True
+    atoms.append(node)
+    return True
+
+
+def _atom_of(expr: E.Expression,
+             name_to_atom: Dict[str, int]) -> Optional[int]:
+    """The single atom an expression's references resolve to; None when
+    it spans atoms or references nothing (a literal key)."""
+    refs = expr.references()
+    owners = {name_to_atom.get(n) for n in refs}
+    if len(owners) != 1 or None in owners:
+        return None
+    return owners.pop()
+
+
+def reorder_joins(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Top-down pass: reorder every maximal inner-join cluster of >= 3
+    relations by greedy smallest-intermediate-first."""
+    if isinstance(plan, L.Join) and plan.how == "inner":
+        reordered = _reorder_cluster(plan)
+        if reordered is not None:
+            return reordered
+    return plan.with_children(tuple(
+        reorder_joins(c) for c in plan.children()))
+
+
+def _reorder_cluster(root: L.Join) -> Optional[L.LogicalPlan]:
+    atoms: List[L.LogicalPlan] = []
+    key_pairs: List[Tuple[E.Expression, E.Expression]] = []
+    conds: List[E.Expression] = []
+    if not _flatten(root, atoms, key_pairs, conds) or len(atoms) < 3:
+        return None
+
+    # global name uniqueness: expressions keep meaning under any order
+    name_to_atom: Dict[str, int] = {}
+    for i, a in enumerate(atoms):
+        for n in a.schema.names:
+            if n in name_to_atom:
+                return None
+            name_to_atom[n] = i
+
+    # edges: (atom_i, atom_j, key_on_i, key_on_j)
+    edges: List[Tuple[int, int, E.Expression, E.Expression]] = []
+    for lk, rk in key_pairs:
+        i = _atom_of(lk, name_to_atom)
+        j = _atom_of(rk, name_to_atom)
+        if i is None or j is None or i == j:
+            return None
+        edges.append((i, j, lk, rk))
+
+    # recurse into atoms first (nested clusters under Projects/aggregates)
+    atoms = [reorder_joins(a) for a in atoms]
+    est = [estimate_rows(a) for a in atoms]
+
+    n = len(atoms)
+    start = min(range(n), key=lambda i: est[i])
+    joined = {start}
+    tree: L.LogicalPlan = atoms[start]
+    tree_est = est[start]
+    while len(joined) < n:
+        connected = set()
+        for (i, j, _, _) in edges:
+            if i in joined and j not in joined:
+                connected.add(j)
+            elif j in joined and i not in joined:
+                connected.add(i)
+        if not connected:
+            # disconnected components despite keys: out of scope
+            return None
+        # cost of joining candidate c next = estimated output size
+        c = min(connected, key=lambda x: (max(tree_est, est[x]), est[x]))
+        lkeys: List[E.Expression] = []
+        rkeys: List[E.Expression] = []
+        for (i, j, ki, kj) in edges:
+            if i in joined and j == c:
+                lkeys.append(ki)
+                rkeys.append(kj)
+            elif j in joined and i == c:
+                lkeys.append(kj)
+                rkeys.append(ki)
+        tree = L.Join(tree, atoms[c], "inner",
+                      tuple(lkeys), tuple(rkeys), None)
+        tree_est = max(tree_est, est[c])
+        joined.add(c)
+
+    if conds:
+        from spark_tpu.plan.optimizer import combine_conjuncts
+
+        tree = L.Filter(combine_conjuncts(conds), tree)
+    # restore the original output column order for parents
+    orig = root.schema.names
+    if tuple(tree.schema.names) != tuple(orig):
+        tree = L.Project(tuple(E.Col(nm) for nm in orig), tree)
+    return tree

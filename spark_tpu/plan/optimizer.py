@@ -512,4 +512,31 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
             plan = new_plan
             break
         plan = new_plan
+    if _cbo_enabled():
+        from spark_tpu.plan.join_reorder import reorder_joins
+
+        plan = reorder_joins(plan)
+    for rule in _extension_rules():
+        plan = rule(plan)
     return prune_columns(plan)
+
+
+def _extension_rules() -> Tuple[Rule, ...]:
+    """Session-injected rules (reference:
+    SparkSessionExtensions.injectOptimizerRule:268)."""
+    from spark_tpu.api.session import SparkSession
+
+    sess = SparkSession._active
+    if sess is None:
+        return ()
+    return tuple(sess.extensions.optimizer_rules())
+
+
+def _cbo_enabled() -> bool:
+    from spark_tpu import conf
+    from spark_tpu.api.session import SparkSession
+
+    sess = SparkSession._active
+    if sess is None:
+        return bool(conf.CBO_JOIN_REORDER.default)
+    return bool(sess.conf.get(conf.CBO_JOIN_REORDER))
