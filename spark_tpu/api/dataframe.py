@@ -271,6 +271,8 @@ class DataFrame:
     def _execute(self):
         from spark_tpu import metrics
 
+        if self._session is not None:
+            self._session._ensure_active()
         metrics.query_start(self._plan.node_string())
         ex = getattr(self._session, "mesh_executor", None) \
             if self._session is not None else None
@@ -302,7 +304,14 @@ class DataFrame:
 
         plan = self._plan
         if self._session is not None:
+            from spark_tpu.recovery import run_stage_with_recovery
+
             plan = self._session.cache_manager.apply(plan, run_full)
+            # lineage recompute on transient environment failure
+            # (reference: DAGScheduler.scala:1762 stage resubmission)
+            return run_stage_with_recovery(
+                lambda: run_full(plan), conf=self._session.conf,
+                label=type(self._plan).__name__)
         return run_full(plan)
 
     def collect(self) -> List[Row]:
@@ -328,6 +337,61 @@ class DataFrame:
 
     def toPandas(self):
         return self._execute().to_pandas()
+
+    @property
+    def na(self):
+        """Null handling (reference: DataFrameNaFunctions.scala)."""
+        from spark_tpu.api.na_stat import DataFrameNaFunctions
+
+        return DataFrameNaFunctions(self)
+
+    @property
+    def stat(self):
+        """Statistics (reference: DataFrameStatFunctions.scala)."""
+        from spark_tpu.api.na_stat import DataFrameStatFunctions
+
+        return DataFrameStatFunctions(self)
+
+    def dropna(self, how: str = "any", thresh=None, subset=None):
+        return self.na.drop(how, thresh, subset)
+
+    def fillna(self, value, subset=None):
+        return self.na.fill(value, subset)
+
+    def replace(self, to_replace, value=None, subset=None):
+        return self.na.replace(to_replace, value, subset)
+
+    def describe(self, *cols: str):
+        from spark_tpu.api.na_stat import describe
+
+        return describe(self, list(cols) or None)
+
+    summary = describe
+
+    def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
+        return self.stat.corr(col1, col2, method)
+
+    def cov(self, col1: str, col2: str) -> float:
+        return self.stat.cov(col1, col2)
+
+    def approxQuantile(self, col, probabilities, relativeError=0.0):
+        return self.stat.approxQuantile(col, probabilities, relativeError)
+
+    def crosstab(self, col1: str, col2: str):
+        return self.stat.crosstab(col1, col2)
+
+    def freqItems(self, cols, support: float = 0.01):
+        return self.stat.freqItems(cols, support)
+
+    def sampleBy(self, col: str, fractions, seed: int = 42):
+        return self.stat.sampleBy(col, fractions, seed)
+
+    @property
+    def rdd(self):
+        """Bridge to the RDD tier: collected Rows, partitioned over the
+        default parallelism (reference: Dataset.rdd — the escape hatch
+        out of the columnar engine)."""
+        return self._session.sparkContext.parallelize(self.collect())
 
     def toArrow(self):
         from spark_tpu.columnar.arrow import to_arrow
@@ -395,8 +459,21 @@ class DataFrame:
             self._session.cache_manager.drop(self._plan)
         return self
 
-    def checkpoint(self) -> "DataFrame":
-        return self.cache()
+    def checkpoint(self, eager: bool = True) -> "DataFrame":
+        """Durable checkpoint: Parquet under spark.checkpoint.dir,
+        lineage truncated (reference: Dataset.checkpoint →
+        ReliableCheckpointRDD)."""
+        from spark_tpu.recovery import checkpoint_dataframe
+
+        return checkpoint_dataframe(self, eager=eager)
+
+    def localCheckpoint(self, eager: bool = True) -> "DataFrame":
+        """In-memory lineage truncation (reference:
+        Dataset.localCheckpoint → LocalCheckpointRDD)."""
+        df = self.cache()
+        if eager:
+            df.count()
+        return df
 
 
 def _fmt(v, truncate: bool) -> str:

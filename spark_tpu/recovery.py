@@ -1,0 +1,185 @@
+"""Fault tolerance: heartbeats, stage retry, durable checkpoints.
+
+Reference peers:
+- stage re-execution from lineage on task loss
+  (core/.../scheduler/DAGScheduler.scala:1762 handleTaskCompletion →
+  resubmit; TaskSetManager maxTaskFailures) — here the *logical plan is
+  the lineage*: re-running a query recomputes every stage from source
+  data, so recovery = retry the plan, optionally from a durable
+  checkpoint that truncates the lineage;
+- executor heartbeats (core/.../HeartbeatReceiver.scala:67) — here a
+  driver-side monitor thread that proves the device/backend is still
+  answering (a dead TPU host fails the next collective anyway — SPMD
+  makes failure detection synchronous — the heartbeat exists to catch
+  hangs *between* queries and surface them in the event log);
+- reliable checkpoint (core/.../rdd/ReliableCheckpointRDD.scala) —
+  ``checkpoint_dataframe`` writes Parquet and replans over the files.
+
+Deliberately NOT rebuilt: per-task speculation and partition-level
+re-fetch. A pjit stage is a gang — all shards advance or none do —
+so the recovery unit is the stage program, not a task.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from spark_tpu import conf as CF
+from spark_tpu import metrics
+
+STAGE_MAX_ATTEMPTS = CF.register(
+    "spark.stage.maxConsecutiveAttempts", 4,
+    "Attempts for a stage/query whose failure looks transient "
+    "(reference: config/package.scala STAGE_MAX_CONSECUTIVE_ATTEMPTS).",
+    int)
+
+CHECKPOINT_DIR = CF.register(
+    "spark.checkpoint.dir", "",
+    "Durable checkpoint directory for DataFrame.checkpoint() "
+    "(reference: SparkContext.setCheckpointDir).", str)
+
+HEARTBEAT_INTERVAL = CF.register(
+    "spark.executor.heartbeatInterval", 10.0,
+    "Seconds between device liveness probes (reference: "
+    "HeartbeatReceiver.scala HEARTBEAT_INTERVAL).", float)
+
+# Error-message fragments that indicate the *environment* failed (a
+# host dropped out of the collective, the tunnel died, a deadline
+# passed) rather than the query being wrong. Only these are retried —
+# retrying a genuine bug would just quadruple its latency.
+_TRANSIENT_MARKERS = (
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "connection reset",
+    "Connection reset",
+    "socket closed",
+    "device or resource busy",
+    "halted",          # TPU halt: chip needs re-init
+    "slice has failed",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    msg = str(exc)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
+    """Run ``fn`` (a stage/query execution thunk), retrying transient
+    environment failures up to spark.stage.maxConsecutiveAttempts times.
+    Each retry recomputes from lineage — ``fn`` must replan from the
+    logical plan, not replay captured device buffers."""
+    attempts = int(conf.get(STAGE_MAX_ATTEMPTS)) if conf is not None \
+        else STAGE_MAX_ATTEMPTS.default
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, attempts)):
+        try:
+            return fn()
+        except Exception as e:
+            if not is_transient(e):
+                raise
+            last = e
+            metrics.record("stage_retry", label=label, attempt=attempt,
+                           error=repr(e))
+            time.sleep(min(2.0 ** attempt * 0.1, 2.0))
+    raise RuntimeError(
+        f"{label} failed {attempts} consecutive attempts "
+        f"(last: {last!r})") from last
+
+
+class HeartbeatMonitor:
+    """Driver-side liveness probe: a daemon thread runs a trivial device
+    computation every interval and records the result in the event log.
+    ``healthy()`` is False once a probe fails or the loop stops beating
+    (hang detection)."""
+
+    def __init__(self, interval_s: Optional[float] = None):
+        self.interval = float(interval_s if interval_s is not None
+                              else HEARTBEAT_INTERVAL.default)
+        self._stop = threading.Event()
+        self._last_ok: Optional[float] = None
+        self._last_error: Optional[str] = None
+        self._last_err_ts: float = 0.0
+        self._thread: Optional[threading.Thread] = None
+
+    def _probe(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.device_put(jnp.ones((8,), jnp.float32))
+        got = float(jnp.sum(x).block_until_ready())
+        if got != 8.0:
+            raise RuntimeError(f"heartbeat probe computed {got} != 8.0")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._probe()
+                self._last_ok = time.time()
+                metrics.record("heartbeat", ok=True)
+            except Exception as e:
+                self._last_error = repr(e)
+                self._last_err_ts = time.time()
+                metrics.record("heartbeat", ok=False, error=repr(e))
+
+    def start(self) -> "HeartbeatMonitor":
+        if self._thread is None:
+            # one immediate synchronous probe so healthy() is meaningful
+            # right away
+            try:
+                self._probe()
+                self._last_ok = time.time()
+            except Exception as e:
+                self._last_error = repr(e)
+                self._last_err_ts = time.time()
+            self._thread = threading.Thread(
+                target=self._loop, name="spark-tpu-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def healthy(self, max_silence_s: Optional[float] = None) -> bool:
+        if self._last_ok is None:
+            return False
+        if self._last_err_ts > self._last_ok:  # failed since last success
+            return False
+        silence = max_silence_s if max_silence_s is not None \
+            else 3 * self.interval
+        return (time.time() - self._last_ok) <= silence
+
+    def status(self) -> dict:
+        return {"last_ok": self._last_ok, "last_error": self._last_error,
+                "interval_s": self.interval}
+
+
+_CKPT_COUNTER = [0]
+
+
+def checkpoint_dataframe(df, eager: bool = True):
+    """Durable checkpoint: materialize to Parquet under
+    spark.checkpoint.dir and return a DataFrame scanning the files —
+    lineage truncated, survives the session (reference:
+    ReliableCheckpointRDD; RDD.scala:1627)."""
+    session = df.sparkSession
+    d = str(session.conf.get(CHECKPOINT_DIR) or "")
+    if not d:
+        raise RuntimeError(
+            "set spark.checkpoint.dir (or SparkContext.setCheckpointDir) "
+            "before calling checkpoint(); use localCheckpoint() for the "
+            "in-memory variant")
+    _CKPT_COUNTER[0] += 1
+    path = os.path.join(d, f"ckpt-{os.getpid()}-{_CKPT_COUNTER[0]}")
+    df.write.mode("overwrite").parquet(path)
+    out = session.read.parquet(path)
+    if eager:
+        out.count()
+    return out

@@ -1,0 +1,164 @@
+"""RDD tier (spark_tpu/rdd.py; reference: core/.../rdd/RDD.scala,
+scheduler task retry TaskSetManager.scala)."""
+
+import os
+
+import pytest
+
+
+@pytest.fixture
+def sc(spark):
+    return spark.sparkContext
+
+
+def test_parallelize_map_filter_collect(sc):
+    r = sc.parallelize(range(100), 4)
+    assert r.getNumPartitions() == 4
+    out = r.map(lambda x: x * 2).filter(lambda x: x % 10 == 0).collect()
+    assert out == [x * 2 for x in range(100) if (x * 2) % 10 == 0]
+    assert r.count() == 100
+    assert r.sum() == 4950
+    assert r.take(3) == [0, 1, 2]
+    assert r.first() == 0
+
+
+def test_flatmap_distinct_union(sc):
+    r = sc.parallelize(["a b", "b c", "a c"])
+    words = r.flatMap(str.split)
+    assert words.count() == 6
+    assert sorted(words.distinct().collect()) == ["a", "b", "c"]
+    u = sc.parallelize([1, 2]).union(sc.parallelize([3]))
+    assert sorted(u.collect()) == [1, 2, 3]
+
+
+def test_reduce_fold_aggregate(sc):
+    r = sc.parallelize(range(1, 11), 3)
+    assert r.reduce(lambda a, b: a + b) == 55
+    assert r.fold(0, lambda a, b: a + b) == 55
+    n, s = r.aggregate((0, 0),
+                       lambda acc, x: (acc[0] + 1, acc[1] + x),
+                       lambda a, b: (a[0] + b[0], a[1] + b[1]))
+    assert (n, s) == (10, 55)
+
+
+def test_bykey_ops(sc):
+    pairs = sc.parallelize(
+        [("a", 1), ("b", 2), ("a", 3), ("c", 4), ("b", 5)], 3)
+    assert dict(pairs.reduceByKey(lambda a, b: a + b).collect()) == \
+        {"a": 4, "b": 7, "c": 4}
+    grouped = dict(pairs.groupByKey().mapValues(sorted).collect())
+    assert grouped == {"a": [1, 3], "b": [2, 5], "c": [4]}
+    assert pairs.countByKey() == {"a": 2, "b": 2, "c": 1}
+    avg = pairs.combineByKey(
+        lambda v: (v, 1),
+        lambda c, v: (c[0] + v, c[1] + 1),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]))
+    assert dict(avg.mapValues(lambda c: c[0] / c[1]).collect()) == \
+        {"a": 2.0, "b": 3.5, "c": 4.0}
+
+
+def test_join_cogroup(sc):
+    left = sc.parallelize([("a", 1), ("b", 2), ("a", 3)])
+    right = sc.parallelize([("a", "x"), ("c", "y")])
+    joined = sorted(left.join(right).collect())
+    assert joined == [("a", (1, "x")), ("a", (3, "x"))]
+    louter = sorted(left.leftOuterJoin(right).collect())
+    assert ("b", (2, None)) in louter
+
+
+def test_sort_and_glom(sc):
+    r = sc.parallelize([5, 3, 1, 4, 2], 2)
+    assert r.sortBy(lambda x: x).collect() == [1, 2, 3, 4, 5]
+    assert r.sortBy(lambda x: x, ascending=False).collect() == \
+        [5, 4, 3, 2, 1]
+    pairs = sc.parallelize([(2, "b"), (1, "a")])
+    assert pairs.sortByKey().collect() == [(1, "a"), (2, "b")]
+    assert sum(len(p) for p in r.glom().collect()) == 5
+
+
+def test_task_retry_recomputes_from_lineage(sc):
+    """A transiently-failing closure succeeds via lineage recompute
+    (reference: TaskSetManager maxTaskFailures; DAGScheduler resubmit)."""
+    attempts = {"n": 0}
+
+    def flaky(x):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise IOError("transient")
+        return x
+
+    out = sc.parallelize([1], 1).map(flaky).collect()
+    assert out == [1]
+    assert attempts["n"] == 3
+
+
+def test_task_fails_after_budget(sc, spark):
+    def always(x):
+        raise ValueError("deterministic")
+
+    with pytest.raises(RuntimeError, match="task failed"):
+        sc.parallelize([1], 1).map(always).collect()
+
+
+def test_checkpoint_truncates_lineage(sc, tmp_path):
+    sc.setCheckpointDir(str(tmp_path))
+    r = sc.parallelize(range(10), 2).map(lambda x: x + 1)
+    r.checkpoint()
+    assert r.collect() == list(range(1, 11))
+    assert r.isCheckpointed()
+    assert r._parents == ()
+    # reads come from the checkpoint files now
+    assert r.collect() == list(range(1, 11))
+
+
+def test_cache(sc):
+    calls = {"n": 0}
+
+    def tracked(x):
+        calls["n"] += 1
+        return x
+
+    r = sc.parallelize(range(8), 2).map(tracked).cache()
+    assert r.count() == 8
+    first = calls["n"]
+    assert r.count() == 8
+    assert calls["n"] == first  # served from cache
+
+
+def test_textfile_roundtrip(sc, tmp_path):
+    r = sc.parallelize(["x", "y", "z"], 2)
+    out = str(tmp_path / "out")
+    r.saveAsTextFile(out)
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    back = sc.textFile(out)
+    assert sorted(back.collect()) == ["x", "y", "z"]
+
+
+def test_broadcast_accumulator(sc):
+    b = sc.broadcast({"k": 10})
+    acc = sc.accumulator(0)
+    sc.parallelize(range(5)).foreach(lambda x: acc.add(x * b.value["k"]))
+    assert acc.value == 100
+
+
+def test_df_rdd_bridge(sc, spark):
+    df = spark.range(10)
+    r = df.rdd
+    assert r.count() == 10
+    assert sorted(row["id"] for row in r.collect()) == list(range(10))
+    df2 = sc.parallelize([(1, "a"), (2, "b")]).toDF(["n", "s"])
+    assert df2.count() == 2
+    assert set(df2.columns) == {"n", "s"}
+
+
+def test_zip_with_index_sample(sc):
+    r = sc.parallelize(list("abcdef"), 3).zipWithIndex()
+    assert r.collect() == [(c, i) for i, c in enumerate("abcdef")]
+    s = sc.parallelize(range(1000), 4).sample(False, 0.1, seed=1)
+    assert 50 < s.count() < 200
+
+
+def test_debug_string_shows_lineage(sc):
+    r = sc.parallelize([1]).map(lambda x: x).filter(bool)
+    s = r.toDebugString().decode()
+    assert "filter" in s and "map" in s and "parallelize" in s
