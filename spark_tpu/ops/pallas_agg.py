@@ -1,0 +1,172 @@
+"""Pallas TPU kernel: one-pass segmented (grouped) sum.
+
+The hot aggregation path in physical/kernels.py handles small group
+counts with K masked dense reductions (`_masked_reduce`) — K full passes
+over the data column from HBM. That is the right call for tiny K, but
+HBM traffic scales as K*N. This kernel makes ONE pass: the column is
+streamed HBM -> VMEM in (block_rows, 128) tiles, per-group partial sums
+accumulate in a VMEM-resident (K, 128) lane-parallel accumulator, and
+the final cross-lane reduce of the tiny (K, 128) result happens in
+plain XLA outside the kernel.
+
+Reference peer: the Tungsten hash-aggregate inner loop
+(sql/core/.../aggregate/TungstenAggregationIterator.scala:82 probing
+BytesToBytesMap.java:497) — rebuilt as a blocked streaming kernel
+because on TPU the accumulator fits VMEM and "probing" is a vector
+compare, not a pointer chase.
+
+Constraints (checked by ``pallas_available``): float32 data (TPU
+Pallas has no f64; the engine's f64 columns keep the XLA path),
+2 <= K <= 1024 (VMEM accumulator budget), data length padded to the
+block size by the wrapper. Tests run the same kernel with
+``interpret=True`` on CPU against a numpy oracle.
+
+Measured on a v5e (N=16M rows, 80% live, 2026-07): per-pass ms
+
+    K          64      128     256     512     1024    2048
+    this       4.8     10.0    17.6    29.3    ~58     ~116
+    XLA fused  3.9     10.4    12.2    16.8    33.5    63.4
+    scatter    149     153     152     153     158     126
+
+XLA's fused multi-reduction ("K-pass" that the compiler collapses to
+one pass) WINS at runtime — but its compile time is the unrolled
+HLO's: 28 s at K=1024, 64 s at K=2048, vs ~1 s flat for this kernel.
+Selection encoded in physical/kernels.py: K <= 64 XLA fused (compile
+stays sub-second), 64 < K <= 1024 this kernel on TPU (avoids both the
+scatter cliff and multi-second compiles), else scatter/sort paths.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BLOCK_ROWS = 64          # (64, 128) tiles: 8k elements per grid step
+_LANES = 128
+_MAX_K = 1024             # (1024, 128) f32 accumulator = 512 KiB VMEM
+
+
+def pallas_available(dtype, num_segments: int,
+                     platform: Optional[str] = None) -> bool:
+    """Whether the Pallas path applies: TPU backend (or forced via
+    SPARK_TPU_PALLAS=force for interpret-mode testing), supported dtype,
+    accumulator-friendly K."""
+    mode = os.environ.get("SPARK_TPU_PALLAS", "auto")
+    if mode == "0":
+        return False
+    if not (2 <= num_segments <= _MAX_K):
+        return False
+    if jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+        return False
+    if mode == "force":
+        return True
+    if platform is None:
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            return False
+    return platform == "tpu"
+
+
+def _kernel(seg_ref, data_ref, mf_ref, acc_ref, *, num_segments: int):
+    """One grid step: accumulate this (B, 128) tile's per-group,
+    per-lane partial sums into the (K, 128) output accumulator."""
+    from jax.experimental import pallas as pl
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    seg = seg_ref[:]                      # (B, 128) int32
+    data = data_ref[:]                    # (B, 128) f32
+    mf = mf_ref[:]                        # (B, 128) f32 (0/1 mask)
+    masked = data * mf
+
+    def body(k, carry):
+        sel = (seg == k).astype(masked.dtype)          # (B, 128)
+        part = jnp.sum(sel * masked, axis=0, keepdims=True)  # (1, 128)
+        prev = acc_ref[pl.ds(k, 1), :]
+        acc_ref[pl.ds(k, 1), :] = prev + part
+        return carry
+
+    jax.lax.fori_loop(0, num_segments, body, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "interpret",
+                                    "exact_int"))
+def pallas_seg_sum(data: jnp.ndarray, seg: jnp.ndarray,
+                   mask: jnp.ndarray, num_segments: int,
+                   interpret: bool = False,
+                   exact_int: bool = False) -> jnp.ndarray:
+    """Grouped sum of ``data`` (1-D) by segment id in ONE pass over HBM.
+    Rows with mask False (or seg outside [0, K)) contribute nothing.
+    Returns float32[num_segments], or int64 when ``exact_int`` (counts:
+    per-lane accumulators hold exact integers up to 2^24, so the final
+    cross-lane reduce happens in int64)."""
+    from jax.experimental import pallas as pl
+
+    n = data.shape[0]
+    block = _BLOCK_ROWS * _LANES
+    pad = (-n) % block
+    f32 = jnp.float32
+    d = jnp.pad(data.astype(f32), (0, pad))
+    s = jnp.pad(seg.astype(jnp.int32), (0, pad),
+                constant_values=num_segments)  # out of range: ignored
+    m = jnp.pad(mask.astype(f32), (0, pad))
+    rows = (n + pad) // _LANES
+    d2 = d.reshape(rows, _LANES)
+    s2 = s.reshape(rows, _LANES)
+    m2 = m.reshape(rows, _LANES)
+    grid = rows // _BLOCK_ROWS
+
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    acc = pl.pallas_call(
+        functools.partial(_kernel, num_segments=num_segments),
+        grid=(grid,),
+        in_specs=[spec, spec, spec],
+        out_specs=pl.BlockSpec((num_segments, _LANES), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_segments, _LANES), f32),
+        interpret=interpret,
+    )(s2, d2, m2)
+    if exact_int:
+        return acc.astype(jnp.int64).sum(axis=1)
+    return acc.sum(axis=1)
+
+
+# engine-side selection bound: below this the XLA fused multi-reduce
+# compiles fast and runs faster (see measurement table above)
+MIN_ENGINE_K = 64
+
+
+def maybe_pallas_seg_sum(data, seg, mask, num_segments: int):
+    """Engine entry point for float32 grouped sums: the Pallas path when
+    it qualifies, else None (caller falls back to the XLA kernels)."""
+    if num_segments <= MIN_ENGINE_K or \
+            not pallas_available(data.dtype, num_segments):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    return pallas_seg_sum(data, seg, mask, num_segments,
+                          interpret=interpret)
+
+
+def maybe_pallas_seg_count(seg, mask, num_segments: int):
+    """Engine entry point for grouped counts (exact int64 result).
+    Per-(group, lane) f32 accumulators stay exact below 2^24 increments,
+    i.e. up to 2^31 rows — beyond any single static batch."""
+    if num_segments <= MIN_ENGINE_K or \
+            not pallas_available(np.float32, num_segments):
+        return None
+    if seg.shape[0] >= (1 << 31):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    ones = mask.astype(jnp.float32)
+    return pallas_seg_sum(ones, seg, mask, num_segments,
+                          interpret=interpret, exact_int=True)

@@ -168,6 +168,14 @@ def seg_sum(data, seg, mask, num_segments: int, sorted_seg: bool = False):
         return jnp.sum(masked)[None]
     if num_segments <= _MASKED_SEG_LIMIT:
         return _masked_reduce(data, seg, mask, num_segments, jnp.sum, zero)
+    if not sorted_seg:
+        # 64 < K <= 1024, f32, TPU: one-pass Pallas streaming aggregate
+        # (measured 2.5-15x over scatter; see ops/pallas_agg.py table)
+        from spark_tpu.ops import maybe_pallas_seg_sum
+
+        out = maybe_pallas_seg_sum(data, seg, mask, num_segments)
+        if out is not None:
+            return out
     if sorted_seg:
         return _sorted_seg_sum(masked, seg, num_segments)
     return jax.ops.segment_sum(masked, seg, num_segments=num_segments)
@@ -180,6 +188,12 @@ def seg_count(seg, mask, num_segments: int, sorted_seg: bool = False):
     if num_segments <= _MASKED_SEG_LIMIT:
         return _masked_reduce(ones, seg, mask, num_segments, jnp.sum,
                               jnp.zeros((), jnp.int64))
+    if not sorted_seg:
+        from spark_tpu.ops import maybe_pallas_seg_count
+
+        out = maybe_pallas_seg_count(seg, mask, num_segments)
+        if out is not None:
+            return out
     if sorted_seg:
         return _sorted_seg_sum(ones, seg, num_segments)
     return jax.ops.segment_sum(ones, seg, num_segments=num_segments)
