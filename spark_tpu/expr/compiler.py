@@ -82,6 +82,20 @@ def _dict_table(dictionary: Tuple[str, ...], fn) -> np.ndarray:
     return np.array([fn(s) for s in dictionary])
 
 
+_NATIVE_DICT_MIN = 2048
+
+
+def _use_native(dictionary) -> bool:
+    """Large dictionaries route to the C++ kernels (spark_tpu/native):
+    per-entry CPython overhead dominates above a few thousand entries —
+    the TPC-H q13 comment column has ~1.5M distinct values at SF1."""
+    if len(dictionary) < _NATIVE_DICT_MIN:
+        return False
+    from spark_tpu import native
+
+    return native.available()
+
+
 def _like_to_regex(pattern: str) -> "re.Pattern":
     out = []
     for ch in pattern:
@@ -240,9 +254,15 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
 
     if isinstance(expr, E.Like):
         tv = evaluate(expr.child, env)
-        rx = _like_to_regex(expr.pattern)
-        table = _dict_table(tv.dictionary or (),
-                            lambda s: rx.match(s) is not None)
+        dictionary = tv.dictionary or ()
+        if _use_native(dictionary):
+            from spark_tpu import native
+
+            table = native.like_table(dictionary, expr.pattern)
+        else:
+            rx = _like_to_regex(expr.pattern)
+            table = _dict_table(dictionary,
+                                lambda s: rx.match(s) is not None)
         res = jnp.asarray(table)[tv.data] if len(table) else jnp.zeros(
             (n,), dtype=jnp.bool_)
         return TV(res, tv.validity, T.BOOLEAN, None)
@@ -250,12 +270,18 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
     if isinstance(expr, E.StringPredicate):
         tv = evaluate(expr.child, env)
         needle = expr.needle
-        fn = {
-            "startswith": lambda s: s.startswith(needle),
-            "endswith": lambda s: s.endswith(needle),
-            "contains": lambda s: needle in s,
-        }[expr.op]
-        table = _dict_table(tv.dictionary or (), fn)
+        dictionary = tv.dictionary or ()
+        if _use_native(dictionary):
+            from spark_tpu import native
+
+            table = native.predicate_table(dictionary, expr.op, needle)
+        else:
+            fn = {
+                "startswith": lambda s: s.startswith(needle),
+                "endswith": lambda s: s.endswith(needle),
+                "contains": lambda s: needle in s,
+            }[expr.op]
+            table = _dict_table(dictionary, fn)
         res = jnp.asarray(table)[tv.data] if len(table) else jnp.zeros(
             (n,), dtype=jnp.bool_)
         return TV(res, tv.validity, T.BOOLEAN, None)
@@ -309,6 +335,19 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
     if isinstance(expr, E.Coalesce):
         tvs = [evaluate(a, env) for a in expr.args]
         out_dt = tvs[0].dtype
+        out_dict = tvs[0].dictionary
+        if isinstance(out_dt, T.StringType):
+            # args carry DIFFERENT dictionaries (e.g. a column and a
+            # fill literal) — remap every code into the union dictionary
+            # before blending, as Case does
+            union, tables = unify_dictionaries(tuple(
+                tv.dictionary or () for tv in tvs))
+            tvs = [
+                TV(jnp.asarray(t)[tv.data] if len(tv.dictionary or ())
+                   else tv.data, tv.validity, T.STRING, union)
+                for tv, t in zip(tvs, tables)
+            ]
+            out_dict = union
         data = tvs[-1].data
         valid = tvs[-1].validity
         for tv in reversed(tvs[:-1]):
@@ -316,7 +355,7 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             data = jnp.where(v, _cast_data(tv.data, tv.dtype, out_dt), data)
             # valid where this arg is valid OR the later fallback was valid
             valid = None if valid is None else (v | valid)
-        return TV(data, valid, out_dt, tvs[0].dictionary)
+        return TV(data, valid, out_dt, out_dict)
 
     if isinstance(expr, E.ExtractDatePart):
         tv = evaluate(expr.child, env)
