@@ -1,0 +1,257 @@
+"""DataFrameNaFunctions + DataFrameStatFunctions.
+
+Reference: sql/core/.../DataFrameNaFunctions.scala (drop/fill/replace)
+and DataFrameStatFunctions.scala (approxQuantile:75, corr, cov,
+crosstab, freqItems, sampleBy). Everything lowers to engine expressions
+(IsNull/Coalesce/Case aggregates) so it fuses into the same jitted
+stages; only result-shaping (crosstab pivot) happens host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+from spark_tpu import types as T
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+
+
+def _is_numeric(dtype) -> bool:
+    return isinstance(dtype, (T.IntegralType, T.FractionalType))
+
+
+class DataFrameNaFunctions:
+    """df.na — null handling (reference: DataFrameNaFunctions.scala)."""
+
+    def __init__(self, df):
+        self._df = df
+
+    def drop(self, how: str = "any",
+             thresh: Optional[int] = None,
+             subset: Optional[Sequence[str]] = None):
+        df = self._df
+        cols = list(subset) if subset is not None else df.columns
+        nullable = [c for c in cols if df.schema.field(c).nullable]
+        if not nullable:
+            return df
+        not_nulls = [E.Not(E.IsNull(E.Col(c))) for c in nullable]
+        if thresh is not None:
+            # keep rows with >= thresh non-null values among cols
+            counts = [E.Case(((nn, E.Literal(1)),), E.Literal(0))
+                      for nn in not_nulls]
+            # non-subset columns always count as non-null
+            base = len(cols) - len(nullable)
+            total: E.Expression = E.Literal(base)
+            for c in counts:
+                total = E.Arith("+", total, c)
+            return df.filter(E.Cmp(">=", total, E.Literal(int(thresh))))
+        combine = E.And if how == "any" else E.Or
+        cond = not_nulls[0]
+        for nn in not_nulls[1:]:
+            cond = combine(cond, nn)
+        return df.filter(cond)
+
+    def fill(self, value: Union[int, float, str, bool, dict],
+             subset: Optional[Sequence[str]] = None):
+        df = self._df
+        if isinstance(value, dict):
+            mapping: Dict[str, object] = dict(value)
+        else:
+            cols = list(subset) if subset is not None else df.columns
+            mapping = {}
+            for c in cols:
+                f = df.schema.field(c)
+                if isinstance(value, str) and isinstance(f.dtype, T.StringType):
+                    mapping[c] = value
+                elif isinstance(value, bool):
+                    if isinstance(f.dtype, T.BooleanType):
+                        mapping[c] = value
+                elif isinstance(value, (int, float)) and _is_numeric(f.dtype):
+                    mapping[c] = value
+        out = df
+        for c, v in mapping.items():
+            if c not in df.columns or not df.schema.field(c).nullable:
+                continue
+            out = out.withColumn(
+                c, E.Coalesce((E.Col(c), E.Literal(v))))
+        return out
+
+    def replace(self, to_replace, value=None,
+                subset: Optional[Sequence[str]] = None):
+        df = self._df
+        if isinstance(to_replace, dict):
+            pairs = list(to_replace.items())
+        else:
+            olds = to_replace if isinstance(to_replace, (list, tuple)) \
+                else [to_replace]
+            news = value if isinstance(value, (list, tuple)) \
+                else [value] * len(olds)
+            pairs = list(zip(olds, news))
+        cols = list(subset) if subset is not None else df.columns
+        out = df
+        for c in cols:
+            f = df.schema.field(c)
+            branches = []
+            for old, new in pairs:
+                type_ok = (isinstance(old, str)
+                           and isinstance(f.dtype, T.StringType)) or \
+                    (isinstance(old, (int, float))
+                     and not isinstance(old, bool)
+                     and _is_numeric(f.dtype))
+                if type_ok:
+                    branches.append((E.Cmp("==", E.Col(c), E.Literal(old)),
+                                     E.Literal(new, f.dtype)))
+            if branches:
+                out = out.withColumn(
+                    c, E.Case(tuple(branches), E.Col(c)))
+        return out
+
+
+class DataFrameStatFunctions:
+    """df.stat (reference: DataFrameStatFunctions.scala)."""
+
+    def __init__(self, df):
+        self._df = df
+
+    def approxQuantile(self, col: Union[str, Sequence[str]],
+                       probabilities: Sequence[float],
+                       relativeError: float = 0.0) -> List:
+        """Quantiles per column. Computed exactly (device sort + host
+        pick), which trivially satisfies any requested error bound —
+        the reference's Greenwald-Khanna sketch exists to avoid a JVM
+        shuffle, which this engine doesn't pay."""
+        cols = [col] if isinstance(col, str) else list(col)
+        out = []
+        for c in cols:
+            import numpy as np
+
+            vals = np.asarray(
+                [r[c] for r in self._df.select(c).collect()
+                 if r[c] is not None], dtype=np.float64)
+            if vals.size == 0:
+                out.append([float("nan")] * len(probabilities))
+                continue
+            vals.sort()
+            qs = []
+            for p in probabilities:
+                idx = min(int(p * vals.size), vals.size - 1)
+                qs.append(float(vals[idx]))
+            out.append(qs)
+        return out[0] if isinstance(col, str) else out
+
+    def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
+        if method != "pearson":
+            raise ValueError("only pearson correlation is supported "
+                             "(reference: StatFunctions.pearsonCorrelation)")
+        import math
+
+        s = self._moments(col1, col2)
+        n = s["n"]
+        if n == 0:
+            return float("nan")
+        cov = s["xy"] / n - (s["x"] / n) * (s["y"] / n)
+        vx = s["xx"] / n - (s["x"] / n) ** 2
+        vy = s["yy"] / n - (s["y"] / n) ** 2
+        denom = math.sqrt(vx * vy)
+        return cov / denom if denom else float("nan")
+
+    def cov(self, col1: str, col2: str) -> float:
+        s = self._moments(col1, col2)
+        n = s["n"]
+        if n <= 1:
+            return float("nan")
+        # sample covariance (reference: StatFunctions.calculateCov)
+        return (s["xy"] - s["x"] * s["y"] / n) / (n - 1)
+
+    def _moments(self, c1: str, c2: str) -> Dict[str, float]:
+        df = self._df
+        x = E.Cast(E.Col(c1), T.FLOAT64)
+        y = E.Cast(E.Col(c2), T.FLOAT64)
+        agg = df.agg(
+            E.Alias(E.Sum(x), "x"), E.Alias(E.Sum(y), "y"),
+            E.Alias(E.Sum(x * y), "xy"),
+            E.Alias(E.Sum(x * x), "xx"), E.Alias(E.Sum(y * y), "yy"),
+            E.Alias(E.Count(x), "n"))
+        r = agg.collect()[0]
+        return {k: (float(r[k]) if r[k] is not None else 0.0)
+                for k in ("x", "y", "xy", "xx", "yy", "n")}
+
+    def crosstab(self, col1: str, col2: str):
+        """Contingency table as a DataFrame: one row per distinct col1,
+        one column per distinct col2 (reference: StatFunctions.crossTabulate)."""
+        df = self._df
+        rows = df.groupBy(col1, col2).count().collect()
+        import pyarrow as pa
+
+        row_keys = sorted({str(r[col1]) for r in rows})
+        col_keys = sorted({str(r[col2]) for r in rows})
+        counts = {(str(r[col1]), str(r[col2])): r["count"] for r in rows}
+        data = {f"{col1}_{col2}": row_keys}
+        for ck in col_keys:
+            data[ck] = [counts.get((rk, ck), 0) for rk in row_keys]
+        return df.sparkSession.createDataFrame(pa.table(data))
+
+    def freqItems(self, cols: Sequence[str], support: float = 0.01):
+        """Columns of frequent items (appearing in >= support fraction
+        of rows). Exact counting via group-by (the reference uses a
+        lossy counting sketch for one-pass JVM streaming). Deviation:
+        the engine has no array columns yet, so each ``{col}_freqItems``
+        cell is the item list serialized as a JSON string."""
+        import json
+
+        import pyarrow as pa
+
+        df = self._df
+        total = df.count()
+        floor = max(1.0, total * support)  # frequency >= support * n
+        data = {}
+        for c in cols:
+            counted = df.groupBy(c).count().collect()
+            items = sorted((r[c] for r in counted
+                            if r["count"] >= floor),
+                           key=lambda x: (x is None, str(x)))
+            data[f"{c}_freqItems"] = [json.dumps(items)]
+        return df.sparkSession.createDataFrame(pa.table(data))
+
+    def sampleBy(self, col: str, fractions: Dict, seed: int = 42):
+        """Stratified sample: per-stratum Bernoulli sampling, unioned —
+        each branch stays an engine-native Sample node."""
+        df = self._df
+        out = None
+        for i, (k, frac) in enumerate(sorted(fractions.items(),
+                                             key=lambda kv: str(kv[0]))):
+            part = df.filter(E.Cmp("==", E.Col(col), E.Literal(k))) \
+                .sample(float(frac), seed=seed + i)
+            out = part if out is None else out.union(part)
+        return out if out is not None else df.limit(0)
+
+
+def describe(df, cols: Optional[Sequence[str]] = None):
+    """count/mean/stddev/min/max per numeric column (reference:
+    Dataset.describe -> StatFunctions.summary)."""
+    import pyarrow as pa
+
+    names = [c for c in (cols or df.columns)
+             if _is_numeric(df.schema.field(c).dtype)]
+    aggs = []
+    for c in names:
+        x = E.Cast(E.Col(c), T.FLOAT64)
+        aggs += [E.Alias(E.Count(x), f"n_{c}"),
+                 E.Alias(E.Avg(x), f"mean_{c}"),
+                 E.Alias(E.StddevVariance("stddev_samp", x),
+                         f"std_{c}"),
+                 E.Alias(E.Min(x), f"min_{c}"),
+                 E.Alias(E.Max(x), f"max_{c}")]
+    if not aggs:
+        return df.limit(0)
+    r = df.agg(*aggs).collect()[0]
+
+    def fmt(v):
+        return None if v is None else str(v)
+
+    data = {"summary": ["count", "mean", "stddev", "min", "max"]}
+    for c in names:
+        data[c] = [fmt(r[f"n_{c}"]), fmt(r[f"mean_{c}"]),
+                   fmt(r[f"std_{c}"]), fmt(r[f"min_{c}"]),
+                   fmt(r[f"max_{c}"])]
+    return df.sparkSession.createDataFrame(pa.table(data))

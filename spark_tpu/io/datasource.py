@@ -148,7 +148,40 @@ class FileSource:
 
     # -- dataset / schema ----------------------------------------------------
 
+    def _fingerprint(self) -> tuple:
+        """Freshness token over the underlying files ((path, mtime_ns,
+        size) tuples) so a re-read after a rewrite never serves stale
+        cached batches, and the memoized pyarrow dataset (which pins its
+        discovered file list) is rebuilt (round-2 advisor finding)."""
+        import os
+
+        out = []
+        for p in self.paths:
+            if os.path.isdir(p):
+                for root, _, files in os.walk(p):
+                    for f in sorted(files):
+                        fp = os.path.join(root, f)
+                        try:
+                            st = os.stat(fp)
+                            out.append((fp, st.st_mtime_ns, st.st_size))
+                        except OSError:
+                            pass
+            else:
+                try:
+                    st = os.stat(p)
+                    out.append((p, st.st_mtime_ns, st.st_size))
+                except OSError:
+                    pass
+        return tuple(out)
+
     def _open(self) -> pads.Dataset:
+        fp = self._fingerprint()
+        if getattr(self, "_fp", None) != fp:
+            # underlying files changed: drop dataset + batch/count caches
+            self._dataset = None
+            self._cache.clear()
+            self._count_cache.clear()
+            self._fp = fp
         if self._dataset is not None:
             return self._dataset
         kwargs: Dict[str, Any] = {}
@@ -187,6 +220,11 @@ class FileSource:
             kwargs["format"] = "json"
             if str(self.options.get("partitioning", "")) == "hive":
                 kwargs["partitioning"] = "hive"
+        elif self.fmt == "orc":
+            # pyarrow's C++ ORC reader — the vectorized-decoder tier the
+            # reference reaches via Java ORC (OrcColumnarBatchReader)
+            kwargs["format"] = "orc"
+            kwargs["partitioning"] = "hive"
         else:
             raise ValueError(f"unsupported format {self.fmt!r}")
         if self._schema is not None and self.fmt == "parquet":
@@ -210,12 +248,12 @@ class FileSource:
         ``columns`` and pruning/filtering by ``filters`` (exact)."""
         from spark_tpu.columnar.arrow import from_arrow
 
+        ds = self._open()  # first: freshness check may clear the cache
         key = (columns, tuple(E.expr_key(f) for f in filters))
         hit = self._cache.get(key)
         if hit is not None:
             self._cache[key] = self._cache.pop(key)  # LRU touch
             return hit
-        ds = self._open()
         table = ds.to_table(
             columns=list(columns) if columns is not None else None,
             filter=_filters_to_pads(filters))
@@ -231,10 +269,11 @@ class FileSource:
         """Row count without materializing (drives the out-of-HBM
         chunking decision). Memoized per filter set — the decision runs
         on every execution of an aggregate-over-scan query."""
+        ds = self._open()  # freshness check may clear the count cache
         key = tuple(E.expr_key(f) for f in filters)
         hit = self._count_cache.get(key)
         if hit is None:
-            hit = self._open().count_rows(filter=_filters_to_pads(filters))
+            hit = ds.count_rows(filter=_filters_to_pads(filters))
             self._count_cache[key] = hit
         return hit
 

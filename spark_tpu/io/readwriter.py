@@ -74,6 +74,10 @@ class DataFrameReader:
         self._format = "csv"
         return self.load(path, schema=schema, **options)
 
+    def orc(self, *paths: str):
+        self._format = "orc"
+        return self.load(list(paths) if len(paths) > 1 else paths[0])
+
     def json(self, path: Union[str, Sequence[str]], **options: Any):
         self._format = "json"
         return self.load(path, **options)
@@ -186,6 +190,19 @@ class DataFrameWriter:
             table.to_pandas().to_json(fname, orient="records", lines=True,
                                       date_format="iso")
             return
+        elif self._format == "orc":
+            # pyarrow.dataset cannot WRITE orc; use the direct writer
+            if self._partition_by:
+                raise NotImplementedError(
+                    "partitionBy with the ORC writer is not supported "
+                    "(pyarrow's dataset writer has no ORC output); use "
+                    "parquet for partitioned layouts")
+            from pyarrow import orc as paorc
+
+            os.makedirs(path, exist_ok=True)
+            fname = os.path.join(path, f"part-00000-{part_id}.orc")
+            paorc.write_table(table, fname)
+            return
         pads.write_dataset(
             table, path, format=fmt,
             file_options=write_opts,
@@ -205,5 +222,8 @@ class DataFrameWriter:
 
     def json(self, path: str, mode: Optional[str] = None) -> None:
         self.save(path, format="json", mode=mode)
+
+    def orc(self, path: str, mode: Optional[str] = None) -> None:
+        self.save(path, format="orc", mode=mode)
 
 

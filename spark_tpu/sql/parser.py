@@ -667,6 +667,17 @@ class _ExprParser:
             m = self._int_literal()
             self.expect(")")
             return E.AddMonths(e, m)
+        # session-injected functions (reference:
+        # SparkSessionExtensions.injectFunction:344)
+        builder = _extension_function(name)
+        if builder is not None:
+            args = []
+            if not self.accept(")"):
+                args.append(self.parse())
+                while self.accept(","):
+                    args.append(self.parse())
+                self.expect(")")
+            return builder(*args)
         raise SQLParseError(f"unknown function {name_tok.value!r} "
                             f"at {name_tok.pos}")
 
@@ -1155,6 +1166,16 @@ class _NoCatalog:
     def lookup(self, name: str):
         raise SQLParseError(
             f"table or view not found: {name} (no catalog in scope)")
+
+
+def _extension_function(name: str):
+    """Builder for a session-injected function, or None."""
+    from spark_tpu.api.session import SparkSession
+
+    sess = SparkSession._active
+    if sess is None:
+        return None
+    return sess.extensions.function(name)
 
 
 def parse_sql(query: str, catalog=None) -> L.LogicalPlan:

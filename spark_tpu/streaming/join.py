@@ -1,0 +1,322 @@
+"""Stream-stream joins (reference:
+sql/core/.../streaming/StreamingSymmetricHashJoinExec.scala — symmetric
+hash join with per-side watermark-bounded state;
+UnsupportedOperationChecker for the mode/type matrix).
+
+Micro-batch formulation over the batch engine: keep every row seen so
+far per side (watermark-trimmed), and per trigger emit
+
+    new_left  JOIN (right_state UNION new_right)
+    UNION  left_state JOIN new_right
+
+which covers old x new, new x old and new x new exactly once. The joins
+themselves are ordinary batch L.Join executions, so they run fused on
+whatever engine the session uses (single chip or mesh). State is one
+arrow table per side per committed version, snapshotted like streaming
+aggregation state (state.py); the global watermark is the MIN of the
+per-side watermarks (matching the reference's WatermarkTracker policy
+for multi-source queries), and rows below it leave the state — bounding
+memory exactly as the reference's state eviction does.
+
+Supported: INNER equi-joins in append mode, optional extra condition.
+Outer stream-stream joins need matched-bit tracking to emit nulls at
+eviction time — explicitly not implemented yet (loud error beats wrong
+results)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_tpu.plan import logical as L
+from spark_tpu.streaming.execution import StreamingSource, _splice
+from spark_tpu.streaming.state import OffsetLog, StateStore
+
+_qids = itertools.count()
+
+
+def find_streaming_join(plan: L.LogicalPlan) -> Optional[L.Join]:
+    """The Join of a two-source streaming query, or None. Stateless
+    operators (Project/Filter/alias — e.g. the column-ordering Project
+    the USING-join API inserts) may sit above the join; they re-run per
+    emitted micro-batch."""
+    sources = L.collect_nodes(plan, StreamingSource)
+    if len(sources) != 2:
+        return None
+    node = plan
+    while isinstance(node, (L.Project, L.Filter, L.SubqueryAlias)):
+        node = node.children()[0]
+    if not isinstance(node, L.Join):
+        raise NotImplementedError(
+            "stream-stream join supports only stateless operators "
+            "(project/filter) above the join")
+    left_srcs = L.collect_nodes(node.left, StreamingSource)
+    right_srcs = L.collect_nodes(node.right, StreamingSource)
+    if len(left_srcs) != 1 or len(right_srcs) != 1:
+        raise NotImplementedError(
+            "each join side must read exactly one streaming source")
+    return node
+
+
+class StreamStreamJoinQuery:
+    """Runner for a two-source streaming join (API-compatible subset of
+    StreamingQuery: processAllAvailable / stop / is_active / name)."""
+
+    def __init__(self, session, root: L.LogicalPlan, plan: L.Join,
+                 sink_name: Optional[str],
+                 output_mode: str = "append",
+                 checkpoint_dir: Optional[str] = None):
+        self._root = root
+        if plan.how != "inner":
+            raise NotImplementedError(
+                f"stream-stream {plan.how} join: only inner joins are "
+                "supported (outer needs matched-bit state)")
+        if output_mode not in ("append", "update"):
+            raise NotImplementedError(
+                "stream-stream joins support append mode only "
+                "(reference: UnsupportedOperationChecker)")
+        if not plan.left_keys:
+            raise NotImplementedError(
+                "stream-stream join requires equi-join keys (unbounded "
+                "cross state otherwise)")
+        self._session = session
+        self._join = plan
+        self.name = sink_name or f"stream{next(_qids)}"
+        self._sides = (L.collect_nodes(plan.left, StreamingSource)[0],
+                       L.collect_nodes(plan.right, StreamingSource)[0])
+        self._subtrees = (plan.left, plan.right)
+        self._log = OffsetLog(checkpoint_dir)
+        self._store = StateStore(checkpoint_dir)
+        self._batch_id = self._log.last_committed
+        self._appended: List[pa.Table] = []
+        wm = self._log.last_watermark()
+        # per-side max event time persisted as a pair in the commit log
+        self._max_event: List[Optional[int]] = list(wm) if \
+            isinstance(wm, (list, tuple)) else [None, None]
+        self.is_active = True
+        self._register_sink()
+
+    # -- engine plumbing ------------------------------------------------------
+
+    def _to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
+        from spark_tpu.columnar.arrow import to_arrow
+        from spark_tpu.physical.planner import execute_logical
+
+        ex = getattr(self._session, "mesh_executor", None)
+        batch = ex.execute_logical(plan) if ex is not None \
+            else execute_logical(plan)
+        return to_arrow(batch)
+
+    def _side_rows(self, side: int, start: int, end: int) -> pa.Table:
+        """New source rows pushed through the side's subtree
+        (projections/filters between source and join). Event-time maxima
+        are tracked on the RAW rows — a projection may drop the
+        watermark column before the join, but the watermark still
+        advances (reference: EventTimeWatermarkExec sits at the
+        source, not at the join)."""
+        from spark_tpu.columnar.arrow import from_arrow
+
+        src = self._sides[side]
+        raw = src.source.get_batch(start, end)
+        wm_col = src.watermark_col
+        if wm_col and raw.num_rows > 0 and wm_col in raw.column_names:
+            import pyarrow.compute as pc
+
+            mx = pc.max(raw.column(wm_col)).as_py()
+            if mx is not None:
+                mx = int(mx)
+                if self._max_event[side] is None \
+                        or mx > self._max_event[side]:
+                    self._max_event[side] = mx
+        subtree = self._subtrees[side]
+        if isinstance(subtree, StreamingSource):
+            return raw
+        return self._to_arrow(_splice(subtree, L.Relation(from_arrow(raw))))
+
+    # -- trigger loop ---------------------------------------------------------
+
+    def process_all_available(self) -> None:
+        while True:
+            batch_id = self._batch_id + 1
+            logged = self._log.offsets_for(batch_id)
+            if logged is not None:
+                starts, ends = logged["start"], logged["end"]
+            else:
+                prev = self._log.offsets_for(self._batch_id)
+                starts = prev["end"] if prev else [0, 0]
+                ends = [self._sides[0].source.latest_offset(),
+                        self._sides[1].source.latest_offset()]
+                if ends[0] <= starts[0] and ends[1] <= starts[1]:
+                    return
+                self._log.log_offsets(batch_id,
+                                      {"start": starts, "end": ends})
+            self._run_batch(batch_id, starts, ends)
+
+    processAllAvailable = process_all_available
+
+    def _run_batch(self, batch_id: int, starts, ends) -> None:
+        new = [self._side_rows(i, starts[i], ends[i]) for i in (0, 1)]
+        state = self._load_state(self._batch_id)
+
+        out_parts = []
+        right_all = pa.concat_tables([state[1], new[1]]) \
+            if state[1].num_rows else new[1]
+        if new[0].num_rows and right_all.num_rows:
+            out_parts.append(self._join_tables(new[0], right_all))
+        if state[0].num_rows and new[1].num_rows:
+            out_parts.append(self._join_tables(state[0], new[1]))
+        out_parts = [self._apply_above(t) for t in out_parts]
+
+        # grow + watermark-trim state
+        new_state = [
+            pa.concat_tables([state[i], new[i]])
+            if state[i].num_rows else new[i]
+            for i in (0, 1)
+        ]
+        wm = self._watermark()
+        if wm is not None:
+            import pyarrow.compute as pc
+
+            for i in (0, 1):
+                wm_col = self._sides[i].watermark_col
+                if wm_col and new_state[i].num_rows > 0 \
+                        and wm_col in new_state[i].column_names:
+                    new_state[i] = new_state[i].filter(
+                        pc.greater_equal(new_state[i].column(wm_col),
+                                         pa.scalar(wm)))
+
+        self._commit_state(batch_id, new_state)
+        self._log.commit(batch_id, watermark=self._max_event)
+        self._batch_id = batch_id
+        for t in out_parts:
+            if t.num_rows:
+                self._appended.append(t)
+        self._register_sink()
+
+    def _watermark(self) -> Optional[int]:
+        """MIN of per-side watermarks (a row may still find matches from
+        the slower side, so the faster side cannot evict past it)."""
+        wms = []
+        for i in (0, 1):
+            if self._sides[i].watermark_col is not None:
+                if self._max_event[i] is None:
+                    return None
+                wms.append(self._max_event[i]
+                           - self._sides[i].watermark_delay)
+        return min(wms) if wms else None
+
+    def _join_tables(self, left: pa.Table, right: pa.Table) -> pa.Table:
+        from spark_tpu.columnar.arrow import from_arrow
+
+        j = L.Join(L.Relation(from_arrow(left)),
+                   L.Relation(from_arrow(right)),
+                   "inner", self._join.left_keys, self._join.right_keys,
+                   self._join.condition)
+        return self._to_arrow(j)
+
+    def _apply_above(self, joined: pa.Table) -> pa.Table:
+        """Re-run the stateless operators above the join (the USING
+        Project, post-join filters) on one emitted batch."""
+        if self._root is self._join:
+            return joined
+        from spark_tpu.columnar.arrow import from_arrow
+
+        rel = L.Relation(from_arrow(joined))
+
+        # transform_up rebuilds ancestors, so identity match fails; the
+        # tree contains exactly ONE Join (find_streaming_join contract)
+        def fn(p):
+            return rel if isinstance(p, L.Join) else p
+
+        return self._to_arrow(self._root.transform_up(fn))
+
+    # -- state layout: one table per side, tagged columns -----------------------
+
+    def _load_state(self, version: int) -> Tuple[pa.Table, pa.Table]:
+        empty = (self._empty_side(0), self._empty_side(1))
+        tbl = self._store.get(version)
+        if tbl is None or tbl.num_rows == 0 or "__side" not in \
+                tbl.column_names:
+            return empty
+        import pyarrow.compute as pc
+
+        out = []
+        for i in (0, 1):
+            part = tbl.filter(pc.equal(tbl.column("__side"), i))
+            names = [n for n in part.column_names
+                     if n.startswith(f"s{i}_")]
+            side = pa.table({n[3:]: part.column(n) for n in names})
+            out.append(side)
+        return tuple(out)  # type: ignore[return-value]
+
+    def _empty_side(self, i: int) -> pa.Table:
+        from spark_tpu.io.datasource import _pa_schema_from_schema
+
+        schema = _pa_schema_from_schema(self._subtrees[i].schema)
+        return pa.Table.from_arrays(
+            [pa.array([], f.type) for f in schema], schema=schema)
+
+    def _commit_state(self, version: int,
+                      sides: List[pa.Table]) -> None:
+        """Pack both sides into one table (prefixed columns + __side
+        tag) so the existing versioned snapshot machinery applies."""
+        parts = []
+        for i, side in enumerate(sides):
+            n = side.num_rows
+            cols = {"__side": pa.array([i] * n, pa.int8())}
+            for j, name in enumerate(side.column_names):
+                cols[f"s{i}_{name}"] = side.column(name)
+            parts.append(cols)
+        # union of columns with nulls on the other side
+        all_names: List[str] = ["__side"]
+        for i, side in enumerate(sides):
+            all_names += [f"s{i}_{n}" for n in side.column_names]
+        arrays = {}
+        for name in all_names:
+            chunks = []
+            for i, cols in enumerate(parts):
+                n = sides[i].num_rows
+                if name in cols:
+                    chunks.append(cols[name])
+                else:
+                    typ = None
+                    for c2 in parts:
+                        if name in c2:
+                            a = c2[name]
+                            typ = a.type if isinstance(a, pa.Array) \
+                                else a.chunk(0).type if a.num_chunks \
+                                else pa.null()
+                            break
+                    chunks.append(pa.nulls(n, typ or pa.null()))
+            arrays[name] = pa.concat_arrays(
+                [c.combine_chunks() if isinstance(c, pa.ChunkedArray)
+                 else c for c in chunks])
+        self._store.commit(version, pa.table(arrays))
+
+    # -- sink -----------------------------------------------------------------
+
+    def _current_result(self) -> pa.Table:
+        if self._appended:
+            return pa.concat_tables(self._appended)
+        return pa.Table.from_arrays(
+            [pa.array([], f.type) for f in self._result_schema()],
+            schema=self._result_schema())
+
+    def _result_schema(self) -> pa.Schema:
+        from spark_tpu.io.datasource import _pa_schema_from_schema
+
+        return _pa_schema_from_schema(self._root.schema)
+
+    def _register_sink(self) -> None:
+        from spark_tpu.columnar.arrow import from_arrow
+
+        tbl = self._current_result()
+        if tbl.num_columns == 0:
+            return
+        self._session.catalog._register_view(
+            self.name, L.Relation(from_arrow(tbl)))
+
+    def stop(self) -> None:
+        self.is_active = False

@@ -67,10 +67,18 @@ class DataStreamWriter:
             self._checkpoint = str(value)
         return self
 
-    def start(self) -> StreamingQuery:
+    def start(self):
         if self._format != "memory":
             raise NotImplementedError(
                 f"streaming sink {self._format!r} (memory only)")
+        from spark_tpu.streaming.join import (StreamStreamJoinQuery,
+                                              find_streaming_join)
+
+        join = find_streaming_join(self._df._plan)
+        if join is not None:
+            return StreamStreamJoinQuery(
+                self._df._session, self._df._plan, join, self._name,
+                self._output_mode, self._checkpoint)
         return StreamingQuery(self._df._session, self._df._plan,
                               self._name, self._output_mode,
                               self._checkpoint)

@@ -1,0 +1,105 @@
+"""Tracing / profiling (SURVEY §5 'Tracing / profiling' row).
+
+Reference mechanisms: per-task TaskMetrics flowing back as accumulators
+(core/.../executor/TaskMetrics.scala:46, util/AccumulatorV2.scala:44),
+per-operator SQLMetrics rendered in the SQL UI
+(metric/SQLMetrics.scala:40, ui/SQLAppStatusListener.scala:40), planner
+phase timing (QueryPlanningTracker.scala), and event-log replay.
+
+TPU build: the device-side truth lives in XLA, so deep profiling maps
+to the jax profiler (TensorBoard-format traces capturing per-HLO device
+time, DMA, and ICI traffic); engine-side accounting reuses the stage
+event stream from metrics.py. This module glues the two:
+
+- ``trace(dir)``: context manager capturing a jax profiler trace of
+  everything executed inside (view with TensorBoard or xprof).
+- ``annotate(name)``: names a region so engine stages are findable
+  inside the device trace (TraceAnnotation).
+- ``query_profile()``: the last query's per-operator wall-time rollup
+  from the event stream — the text form of the SQL-tab DAG view.
+- ``planning_tracker``: phase timing for parse/optimize/plan (the
+  QueryPlanningTracker analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional
+
+from spark_tpu import metrics
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a jax profiler trace (TensorBoard format) of the block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Mark a named region inside a device trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def query_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up the last query's stage events into per-operator totals:
+    {op: {count, total_ms, max_ms}} (the SQL-tab table, text form)."""
+    evs = events if events is not None else metrics.last_query()
+    out: Dict[str, dict] = defaultdict(
+        lambda: {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+    for e in evs:
+        if e.get("kind") != "stage":
+            continue
+        op = e.get("op", "?")
+        ms = float(e.get("ms", 0.0))
+        rec = out[op]
+        rec["count"] += 1
+        rec["total_ms"] = round(rec["total_ms"] + ms, 3)
+        rec["max_ms"] = round(max(rec["max_ms"], ms), 3)
+    return dict(out)
+
+
+def format_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else query_profile()
+    if not p:
+        return "(no stage events recorded)"
+    rows = sorted(p.items(), key=lambda kv: -kv[1]["total_ms"])
+    width = max(len(op) for op, _ in rows)
+    lines = [f"{'operator':<{width}}  count  total_ms  max_ms"]
+    for op, rec in rows:
+        lines.append(f"{op:<{width}}  {rec['count']:>5}  "
+                     f"{rec['total_ms']:>8.2f}  {rec['max_ms']:>6.2f}")
+    return "\n".join(lines)
+
+
+class PlanningTracker:
+    """Phase timing for the planning pipeline (reference:
+    catalyst/QueryPlanningTracker.scala). Use as
+    ``with tracker.phase("optimize"): ...``; phases() returns ms."""
+
+    def __init__(self):
+        self._phases: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._phases[name] = self._phases.get(name, 0.0) + \
+                (time.perf_counter() - t0) * 1e3
+
+    def phases(self) -> Dict[str, float]:
+        return {k: round(v, 3) for k, v in self._phases.items()}

@@ -1,0 +1,78 @@
+"""Session extension points (spark_tpu/extensions.py; reference:
+SparkSessionExtensions.scala, SparkPlugin.java:37)."""
+
+import pytest
+
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+
+
+def test_inject_function_sql_and_resolution(spark):
+    spark.extensions.inject_function(
+        "double_it", lambda e: E.Alias(e * 2, "double_it"))
+    try:
+        rows = spark.sql("select double_it(id) as d from "
+                         "(select 21 as id)").collect()
+        assert rows[0]["d"] == 42
+    finally:
+        spark.extensions._functions.clear()
+
+
+def test_inject_optimizer_rule_runs(spark):
+    seen = {"n": 0}
+
+    def rule(plan):
+        seen["n"] += 1
+        return plan
+
+    spark.extensions.inject_optimizer_rule(rule)
+    try:
+        spark.range(10).filter("id > 3").count()
+        assert seen["n"] >= 1
+    finally:
+        spark.extensions._optimizer_rules.clear()
+
+
+def test_inject_parser_hook(spark):
+    def hook(sql, catalog, default_parse):
+        if sql.strip() == "SHOW MAGIC":
+            return L.Range(0, 3, 1, "magic")
+        return None
+
+    spark.extensions.inject_parser(hook)
+    try:
+        rows = spark.sql("SHOW MAGIC").collect()
+        assert [r["magic"] for r in rows] == [0, 1, 2]
+        # everything else still parses normally
+        assert spark.sql("select 1 as x").collect()[0]["x"] == 1
+    finally:
+        spark.extensions._parser_hooks.clear()
+
+
+class _Plugin:
+    inited = 0
+    shut = 0
+
+    def init(self, session):
+        _Plugin.inited += 1
+
+    def shutdown(self):
+        _Plugin.shut += 1
+
+
+def test_plugin_lifecycle(spark):
+    spark.conf.set("spark.plugins", f"{__name__}:_Plugin")
+    try:
+        spark.extensions.load_plugins(spark)
+        assert _Plugin.inited == 1
+        spark.extensions.shutdown_plugins()
+        assert _Plugin.shut == 1
+    finally:
+        spark.conf.set("spark.plugins", "")
+
+
+def test_unknown_function_still_errors(spark):
+    from spark_tpu.sql.parser import SQLParseError
+
+    with pytest.raises(SQLParseError, match="unknown function"):
+        spark.sql("select no_such_fn(1)")

@@ -1,0 +1,97 @@
+"""df.na / df.stat / describe (spark_tpu/api/na_stat.py; reference:
+DataFrameNaFunctions.scala, DataFrameStatFunctions.scala)."""
+
+import math
+
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture
+def df(spark):
+    return spark.createDataFrame(pa.table({
+        "a": pa.array([1, None, 3, None, 5], pa.int64()),
+        "b": pa.array([10.0, 20.0, None, None, 50.0]),
+        "s": pa.array(["x", None, "y", "x", None]),
+    }))
+
+
+def test_dropna_any_all_thresh(df):
+    assert df.na.drop().count() == 1        # only row 0 fully non-null
+    assert df.na.drop("all").count() == 5   # no row is ALL-null
+    assert df.na.drop("all", subset=["a", "b"]).count() == 4  # row 3 is
+    assert df.dropna(subset=["a"]).count() == 3
+    assert df.na.drop(thresh=2).count() == 3
+    assert df.na.drop(thresh=1).count() == 5
+
+
+def test_fillna(df):
+    out = df.fillna(0, subset=["a"]).collect()
+    assert [r["a"] for r in out] == [1, 0, 3, 0, 5]
+    out2 = df.fillna({"a": -1, "b": 9.5}).collect()
+    assert [r["a"] for r in out2] == [1, -1, 3, -1, 5]
+    assert [r["b"] for r in out2] == [10.0, 20.0, 9.5, 9.5, 50.0]
+    # string fill leaves numerics alone
+    out3 = df.fillna("zz").collect()
+    assert [r["s"] for r in out3] == ["x", "zz", "y", "x", "zz"]
+    assert [r["a"] for r in out3] == [1, None, 3, None, 5]
+
+
+def test_replace(df):
+    out = df.replace(1, 100, subset=["a"]).collect()
+    assert [r["a"] for r in out] == [100, None, 3, None, 5]
+    out2 = df.replace([10.0, 50.0], [11.0, 51.0]).collect()
+    assert [r["b"] for r in out2] == [11.0, 20.0, None, None, 51.0]
+
+
+def test_corr_cov(spark):
+    xs = list(range(50))
+    ys = [3.0 * x + 1.0 for x in xs]
+    d = spark.createDataFrame(pa.table({
+        "x": pa.array([float(x) for x in xs]),
+        "y": pa.array(ys)}))
+    assert abs(d.corr("x", "y") - 1.0) < 1e-9
+    import numpy as np
+
+    want_cov = float(np.cov(xs, ys)[0][1])
+    assert abs(d.cov("x", "y") - want_cov) < 1e-6
+
+
+def test_approx_quantile(spark):
+    d = spark.createDataFrame(pa.table({
+        "v": pa.array([float(i) for i in range(100)])}))
+    q = d.approxQuantile("v", [0.0, 0.5, 0.99])
+    assert q[0] == 0.0 and 49.0 <= q[1] <= 51.0 and q[2] >= 98.0
+
+
+def test_crosstab_freqitems(spark):
+    d = spark.createDataFrame(pa.table({
+        "k": pa.array(["a", "a", "b", "b", "b"]),
+        "v": pa.array([1, 2, 1, 1, 2], pa.int64())}))
+    ct = {r["k_v"]: (r["1"], r["2"]) for r in d.crosstab("k", "v").collect()}
+    assert ct == {"a": (1, 1), "b": (2, 1)}
+    import json
+
+    fi = json.loads(d.freqItems(["k"], support=0.5)
+                    .collect()[0]["k_freqItems"])
+    assert fi == ["b"]
+
+
+def test_sample_by(spark):
+    d = spark.range(1000).withColumn(
+        "g", __import__("spark_tpu.expr.expressions",
+                        fromlist=["Col"]).Col("id") % 2)
+    out = d.sampleBy("g", {0: 0.0, 1: 1.0}, seed=1)
+    rows = out.collect()
+    assert all(r["g"] == 1 for r in rows)
+    assert 400 <= len(rows) <= 500
+
+
+def test_describe(spark):
+    d = spark.createDataFrame(pa.table({
+        "v": pa.array([1.0, 2.0, 3.0, 4.0])}))
+    rows = {r["summary"]: r["v"] for r in d.describe().collect()}
+    assert rows["count"] == "4"
+    assert float(rows["mean"]) == 2.5
+    assert abs(float(rows["stddev"]) - 1.2909944487358056) < 1e-9
+    assert float(rows["min"]) == 1.0 and float(rows["max"]) == 4.0

@@ -1,0 +1,118 @@
+"""Stream-stream joins (spark_tpu/streaming/join.py; reference:
+StreamingSymmetricHashJoinExec.scala)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu.streaming import MemoryStream
+
+
+def _sources(spark):
+    left = MemoryStream(pa.schema([("k", pa.int64()), ("lv", pa.int64())]))
+    right = MemoryStream(pa.schema([("k", pa.int64()), ("rv", pa.int64())]))
+    ldf = spark.readStream.load(left)
+    rdf = spark.readStream.load(right)
+    return left, right, ldf, rdf
+
+
+def test_inner_join_across_batches(spark):
+    left, right, ldf, rdf = _sources(spark)
+    q = ldf.join(rdf, on="k").writeStream \
+        .outputMode("append").queryName("ssj1").start()
+
+    left.add_data([{"k": 1, "lv": 10}, {"k": 2, "lv": 20}])
+    q.processAllAvailable()
+    assert spark.table("ssj1").count() == 0  # right empty so far
+
+    right.add_data([{"k": 1, "rv": 100}])
+    q.processAllAvailable()
+    rows = [tuple(r) for r in spark.sql(
+        "select k, lv, rv from ssj1").collect()]
+    assert rows == [(1, 10, 100)]
+
+    # late-arriving left row still matches OLD right state
+    left.add_data([{"k": 1, "lv": 11}])
+    q.processAllAvailable()
+    rows = sorted(tuple(r) for r in spark.sql(
+        "select k, lv, rv from ssj1").collect())
+    assert rows == [(1, 10, 100), (1, 11, 100)]
+
+
+def test_same_batch_both_sides_no_duplicates(spark):
+    left, right, ldf, rdf = _sources(spark)
+    q = ldf.join(rdf, on="k").writeStream \
+        .outputMode("append").queryName("ssj2").start()
+    left.add_data([{"k": 5, "lv": 1}])
+    right.add_data([{"k": 5, "rv": 2}])
+    q.processAllAvailable()
+    rows = [tuple(r) for r in spark.sql(
+        "select k, lv, rv from ssj2").collect()]
+    assert rows == [(5, 1, 2)]  # exactly once, not twice
+
+
+def test_watermark_bounds_state(spark):
+    left = MemoryStream(pa.schema([("t", pa.int64()), ("k", pa.int64())]))
+    right = MemoryStream(pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                                    ("rv", pa.int64())]))
+    ldf = spark.readStream.load(left).withWatermark("t", 10)
+    rdf = spark.readStream.load(right).withWatermark("t", 10)
+    joined = ldf.join(rdf.drop("t"), on="k")
+    q = joined.writeStream.outputMode("append").queryName("ssj3").start()
+
+    left.add_data([{"t": 0, "k": 1}])
+    right.add_data([{"t": 0, "k": 1, "rv": 7}])
+    q.processAllAvailable()
+    assert spark.table("ssj3").count() == 1
+
+    # advance both sides far past the watermark: old state evicts
+    left.add_data([{"t": 100, "k": 2}])
+    right.add_data([{"t": 100, "k": 2, "rv": 8}])
+    q.processAllAvailable()
+    state = q._load_state(q._batch_id)
+    assert all(t >= 90 for t in state[0].column("t").to_pylist())
+    # a right row for k=1 arriving now misses the evicted left row
+    right.add_data([{"t": 100, "k": 1, "rv": 9}])
+    q.processAllAvailable()
+    rows = sorted(tuple(r) for r in spark.sql(
+        "select k, rv from ssj3").collect())
+    assert (1, 9) not in rows
+
+
+def test_checkpoint_restart(spark, tmp_path):
+    ckpt = str(tmp_path / "ck")
+    left, right, ldf, rdf = _sources(spark)
+    plan = ldf.join(rdf, on="k")
+    q = plan.writeStream.outputMode("append").queryName("ssj4") \
+        .option("checkpointLocation", ckpt).start()
+    left.add_data([{"k": 1, "lv": 10}])
+    q.processAllAvailable()
+    q.stop()
+
+    # restart: state restored; old left row still joinable
+    q2 = plan.writeStream.outputMode("append").queryName("ssj4b") \
+        .option("checkpointLocation", ckpt).start()
+    right.add_data([{"k": 1, "rv": 99}])
+    q2.processAllAvailable()
+    rows = [tuple(r) for r in spark.sql(
+        "select k, lv, rv from ssj4b").collect()]
+    assert rows == [(1, 10, 99)]
+
+
+def test_outer_join_rejected_loudly(spark):
+    left, right, ldf, rdf = _sources(spark)
+    with pytest.raises(NotImplementedError, match="inner"):
+        ldf.join(rdf, on="k", how="left").writeStream \
+            .outputMode("append").start()
+
+
+def test_join_with_projection_below(spark):
+    left, right, ldf, rdf = _sources(spark)
+    ldf2 = ldf.withColumnRenamed("lv", "value").filter("k > 0")
+    q = ldf2.join(rdf, on="k").writeStream \
+        .outputMode("append").queryName("ssj5").start()
+    left.add_data([{"k": -1, "lv": 1}, {"k": 3, "lv": 2}])
+    right.add_data([{"k": 3, "rv": 5}, {"k": -1, "rv": 6}])
+    q.processAllAvailable()
+    rows = [tuple(r) for r in spark.sql(
+        "select k, value, rv from ssj5").collect()]
+    assert rows == [(3, 2, 5)]

@@ -1,0 +1,39 @@
+"""Tracing/profiling glue (spark_tpu/tracing.py; SURVEY §5)."""
+
+import os
+
+from spark_tpu import metrics, tracing
+
+
+def test_query_profile_rolls_up_stage_events(spark):
+    metrics.reset()
+    spark.range(1000).filter("id % 3 = 0").count()
+    prof = tracing.query_profile()
+    assert prof, "no stage events recorded by the engine"
+    assert all({"count", "total_ms", "max_ms"} <= set(v)
+               for v in prof.values())
+    text = tracing.format_profile(prof)
+    assert "operator" in text and "total_ms" in text
+
+
+def test_planning_tracker():
+    t = tracing.PlanningTracker()
+    with t.phase("parse"):
+        pass
+    with t.phase("optimize"):
+        sum(range(1000))
+    with t.phase("optimize"):
+        pass
+    ph = t.phases()
+    assert set(ph) == {"parse", "optimize"} and ph["optimize"] >= 0
+
+
+def test_jax_profiler_trace_writes_files(tmp_path, spark):
+    d = str(tmp_path / "trace")
+    with tracing.trace(d):
+        with tracing.annotate("q1"):
+            spark.range(100).count()
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "jax profiler produced no trace files"
