@@ -158,13 +158,14 @@ def schema_from_arrow(pa_schema: "pa.Schema") -> Schema:
 
 
 def to_arrow(batch: Batch) -> pa.Table:
-    """Device Batch -> Arrow table with only live rows."""
-    mask = np.asarray(batch.data.row_mask)
+    """Device Batch -> Arrow table with only live rows (whole batch
+    fetched in ONE device->host transfer, see Batch.fetch_host)."""
+    mask, host_cols = batch.fetch_host()
     columns = []
     names = []
-    for f, cd in zip(batch.schema.fields, batch.data.columns):
-        data = np.asarray(cd.data)[mask]
-        valid = None if cd.validity is None else np.asarray(cd.validity)[mask]
+    for f, (cdata, cvalid) in zip(batch.schema.fields, host_cols):
+        data = cdata[mask]
+        valid = None if cvalid is None else cvalid[mask]
         if isinstance(f.dtype, T.StringType):
             dictionary = list(f.dictionary or ())
             codes = pa.array(data, type=pa.int32(),

@@ -957,13 +957,24 @@ class JoinExec(PhysicalPlan):
     def traceable(self) -> bool:
         if self.adaptive is None:
             return False
-        _, unique_build, unique_probe = self.adaptive
+        unique_build, unique_probe = self.adaptive[1], self.adaptive[2]
         if unique_build and self.how in ("inner", "left", "left_semi",
                                          "left_anti"):
             return True
         # sides of an INNER join are symmetric: a unique probe side can
         # play the build role (output capacity = right capacity)
-        return unique_probe and self.how == "inner"
+        if unique_probe and self.how == "inner":
+            return True
+        # sized expansion: the first run recorded the bucketed output
+        # capacity for THESE leaf arrays, so even a many-to-many join
+        # replays as one static-shape traced program (no sizing sync)
+        cap = self.adaptive[3] if len(self.adaptive) > 3 else None
+        if cap is not None and self.how in ("inner", "left",
+                                            "left_semi", "left_anti"):
+            return True
+        # semi/anti membership without condition/hash sizes itself
+        return (self.how in ("left_semi", "left_anti")
+                and self.condition is None and self.adaptive[0] != "hash")
 
     def children(self):
         return (self.left, self.right)
@@ -1119,14 +1130,29 @@ class JoinExec(PhysicalPlan):
         probe capacity and no sizing sync is needed. This is the PK-FK
         fast path every TPC-H join takes after the first execution."""
         lpipe, rpipe = child_pipes
-        _, unique_build, unique_probe = self.adaptive
+        unique_build, unique_probe = self.adaptive[1], self.adaptive[2]
+        sized_cap = self.adaptive[3] if len(self.adaptive) > 3 else None
         lcomb, lvalid, rcomb, rvalid, hashed, prepped = self._traced_keys(
             lpipe, rpipe)
-        if not unique_build:
+        if not unique_build and unique_probe and self.how == "inner":
             # inner join with unique LEFT side: swap roles — left becomes
             # the build, output rows ride at right capacity
             return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
                                        rcomb, rvalid, hashed, prepped)
+        if not unique_build:
+            ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
+                                         lcomb, lpipe.mask & lvalid)
+            if sized_cap is None:
+                # semi/anti without condition/hash: membership only, no
+                # expansion needed at any capacity
+                has = ranges.counts > 0
+                keep = lpipe.mask & (has if self.how == "left_semi"
+                                     else ~has)
+                return Pipe(lpipe.cols, keep, lpipe.order)
+            # neither side unique: general expansion at the capacity the
+            # first (blocking) run recorded for these exact leaves
+            return self._pairs_pipe(lpipe, rpipe, ranges, hashed,
+                                    prepped, sized_cap)
         ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
                                      lcomb, lpipe.mask & lvalid)
         has = ranges.counts > 0
@@ -1238,7 +1264,7 @@ class JoinExec(PhysicalPlan):
                 and not hashed:
             if record:
                 maxc = int(jax.device_get(ranges.counts.max()))
-                _JOIN_STATS.put(sk, (packing, maxc <= 1, False))
+                _JOIN_STATS.put(sk, (packing, maxc <= 1, False, None))
             has_match = ranges.counts > 0
             keep = lpipe.mask & (has_match if how == "left_semi"
                                  else ~has_match)
@@ -1247,19 +1273,37 @@ class JoinExec(PhysicalPlan):
         # host sync: output sizing (+ on the FIRST run, max matches per
         # probe row AND per build row — either direction being unique
         # makes this join traceable next execution, swapped roles for a
-        # unique probe; skipped entirely once stats are recorded)
+        # unique probe). The BUCKETED capacity is recorded too: stats are
+        # keyed on the exact leaf arrays, so the match count is
+        # deterministic and re-executions can run the general expansion
+        # fully traced with a static capacity — no host sync, no
+        # blocking stage, even for many-to-many joins.
         if record:
             rev = K.build_join_ranges(lkey, lpipe.mask & lvalid,
                                       rkey, rpipe.mask & rvalid)
             total, maxc, maxb = (int(v) for v in jax.device_get(
                 (ranges.counts.sum(), ranges.counts.max(),
                  rev.counts.max())))
-            # negative results cached too (traceable stays False for
-            # them) so re-executions skip the reverse-ranges probe
-            _JOIN_STATS.put(sk, (packing, maxc <= 1, maxb <= 1))
+            cap = K.bucket(total)
+            # negative uniqueness results cached too; the capacity makes
+            # the sized-expansion trace available regardless
+            _JOIN_STATS.put(sk, (packing, maxc <= 1, maxb <= 1, cap))
         else:
-            total = int(ranges.counts.sum())  # host sync: output sizing
-        cap = K.bucket(total)
+            st = _JOIN_STATS.get(sk) if sk is not None else None
+            if st is not None and len(st) > 3 and st[3] is not None:
+                cap = st[3]  # deterministic for these leaves: no sync
+            else:
+                total = int(ranges.counts.sum())  # host sync: sizing
+                cap = K.bucket(total)
+        return self._pairs_pipe(lpipe, rpipe, ranges, hashed, prepped,
+                                cap).to_batch()
+
+    def _pairs_pipe(self, lpipe: Pipe, rpipe: Pipe, ranges, hashed,
+                    prepped, cap: int) -> Pipe:
+        """General match expansion at a STATIC capacity — pure jnp, so
+        it runs identically as the blocking tail and as the fused
+        sized-expansion trace."""
+        how = self.how
         p_idx, b_idx, pair_mask = K.expand_join_pairs(ranges, cap)
 
         # The pair environment always carries BOTH sides (with '#2'
@@ -1296,7 +1340,7 @@ class JoinExec(PhysicalPlan):
             pair_ok = pair_ok & ctv.data & ctv.valid_or_true(cap)
 
         if how == "inner":
-            return Pipe(cols, pair_ok, order).to_batch()
+            return Pipe(cols, pair_ok, order)
 
         # matched flags must be computed on the ORIGINAL pair arrays,
         # before any unmatched-row appends change the capacity
@@ -1304,9 +1348,9 @@ class JoinExec(PhysicalPlan):
         matched_b = (K.seg_count(b_idx, pair_ok, rpipe.capacity) > 0
                      if how in ("right", "full") else None)
         if how == "left_semi":
-            return Pipe(lpipe.cols, lpipe.mask & matched, lpipe.order).to_batch()
+            return Pipe(lpipe.cols, lpipe.mask & matched, lpipe.order)
         if how == "left_anti":
-            return Pipe(lpipe.cols, lpipe.mask & ~matched, lpipe.order).to_batch()
+            return Pipe(lpipe.cols, lpipe.mask & ~matched, lpipe.order)
 
         if how in ("left", "full"):
             out = append_unmatched_left(cols, pair_ok, order, lpipe, matched)
@@ -1315,7 +1359,7 @@ class JoinExec(PhysicalPlan):
             out = append_unmatched_right(
                 cols, pair_ok, order, lpipe, rpipe, matched_b)
             cols, pair_ok, order, cap = out
-        return Pipe(cols, pair_ok, order).to_batch()
+        return Pipe(cols, pair_ok, order)
 
     def _nested_loop(self, lpipe: Pipe, rpipe: Pipe, how: str) -> Batch:
         """Condition-only join evaluated in fixed-size left-chunks of
