@@ -519,5 +519,32 @@ class GroupedData:
     def max(self, *cols: str) -> DataFrame:  # noqa: A003
         return self._simple(E.Max, cols)
 
+    def applyInPandasWithState(self, func, outputStructType,
+                               stateStructType=None,
+                               outputMode: str = "append",
+                               timeoutConf: str = "NoTimeout") -> DataFrame:
+        """Arbitrary stateful per-group streaming transform (reference:
+        python/pyspark/sql/pandas/group_ops.py applyInPandasWithState →
+        FlatMapGroupsWithStateExec). ``func(key_tuple, pandas_df,
+        GroupState) -> pandas_df``; start the returned DataFrame with
+        writeStream. ``stateStructType``/``timeoutConf`` accepted for
+        surface parity (state is pickled whole; timeouts not implemented)."""
+        from spark_tpu.streaming.groups import FlatMapGroupsWithState
+        from spark_tpu.types import Schema, parse_ddl_schema
+
+        out_schema = (outputStructType
+                      if isinstance(outputStructType, Schema)
+                      else parse_ddl_schema(outputStructType))
+        key_names = []
+        for k in self._keys:
+            inner = E.strip_alias(k)
+            if not isinstance(inner, E.Col):
+                raise NotImplementedError(
+                    "applyInPandasWithState keys must be plain columns")
+            key_names.append(inner.col_name)
+        node = FlatMapGroupsWithState(
+            tuple(key_names), func, out_schema, self._df._plan)
+        return DataFrame(self._df._session, node)
+
     def count(self) -> DataFrame:
         return self.agg(E.Alias(E.Count(None), "count"))

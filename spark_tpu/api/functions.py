@@ -333,6 +333,199 @@ def last_day(c: ColumnOrName) -> Column:
     return E.LastDay(_c(c))
 
 
+# ---- conditional / comparison breadth --------------------------------------
+
+
+def greatest(*cols: ColumnOrName) -> Column:
+    """Largest non-null value (reference: conditionalExpressions.scala
+    Greatest — nulls are skipped, not propagated)."""
+    out = _c(cols[0])
+    for c in cols[1:]:
+        b = _c(c)
+        out = E.Case(((E.IsNull(out), b),
+                      (E.Or(E.IsNull(b), E.Cmp(">=", out, b)), out)), b)
+    return out
+
+
+def least(*cols: ColumnOrName) -> Column:
+    out = _c(cols[0])
+    for c in cols[1:]:
+        b = _c(c)
+        out = E.Case(((E.IsNull(out), b),
+                      (E.Or(E.IsNull(b), E.Cmp("<=", out, b)), out)), b)
+    return out
+
+
+def ifnull(a: ColumnOrName, b: ColumnOrName) -> Column:
+    return E.Coalesce((_c(a), _c(b)))
+
+
+nvl = ifnull
+
+
+def nvl2(a: ColumnOrName, b: ColumnOrName, c: ColumnOrName) -> Column:
+    return E.Case(((E.Not(E.IsNull(_c(a))), _c(b)),), _c(c))
+
+
+def nullif(a: ColumnOrName, b: ColumnOrName) -> Column:
+    x = _c(a)
+    return E.Case(((E.Cmp("==", x, _c(b)), E.Literal(None, T.BOOLEAN)),), x)
+
+
+def negative(c: ColumnOrName) -> Column:
+    return E.Neg(_c(c))
+
+
+def positive(c: ColumnOrName) -> Column:
+    return _c(c)
+
+
+# ---- math breadth -----------------------------------------------------------
+
+
+def log2(c: ColumnOrName) -> Column:
+    import math as _math
+
+    return E.Arith("/", E.UnaryMath("ln", _c(c)),
+                   E.Literal(_math.log(2.0)))
+
+
+def degrees(c: ColumnOrName) -> Column:
+    import math as _math
+
+    return E.Arith("*", _c(c), E.Literal(180.0 / _math.pi))
+
+
+def radians(c: ColumnOrName) -> Column:
+    import math as _math
+
+    return E.Arith("*", _c(c), E.Literal(_math.pi / 180.0))
+
+
+def pmod(a: ColumnOrName, b) -> Column:
+    bb = b if isinstance(b, E.Expression) else E.Literal(b)
+    inner = E.Arith("%", _c(a), bb)
+    return E.Arith("%", E.Arith("+", inner, bb), bb)
+
+
+# ---- datetime breadth -------------------------------------------------------
+
+
+def quarter(c: ColumnOrName) -> Column:
+    m = E.ExtractDatePart("month", _c(c))
+    return E.UnaryMath("floor", E.Arith(
+        "/", E.Arith("+", m, E.Literal(2)), E.Literal(3)))
+
+
+def dayofweek(c: ColumnOrName) -> Column:
+    """1 = Sunday .. 7 = Saturday (reference: datetimeExpressions.scala
+    DayOfWeek). 1970-01-01 (day 0) was a Thursday = 5."""
+    days = E.Cast(_c(c), T.INT64)
+    return E.Arith("+", E.Arith("%", E.Arith("+", days, E.Literal(4)),
+                                E.Literal(7)), E.Literal(1))
+
+
+def weekday(c: ColumnOrName) -> Column:
+    """0 = Monday .. 6 = Sunday."""
+    days = E.Cast(_c(c), T.INT64)
+    return E.Arith("%", E.Arith("+", days, E.Literal(3)), E.Literal(7))
+
+
+def dayofyear(c: ColumnOrName) -> Column:
+    x = _c(c)
+    return E.Arith("+", E.Arith(
+        "-", E.Cast(x, T.INT64),
+        E.Cast(E.DateTrunc("year", x), T.INT64)), E.Literal(1))
+
+
+def months_between(end: ColumnOrName, start: ColumnOrName) -> Column:
+    """Fractional months (reference: datetimeExpressions.scala
+    MonthsBetween): whole-month diff when both dates are the same day of
+    month or both month-ends, else + (day1-day2)/31."""
+    a, b = _c(end), _c(start)
+    whole = E.Arith("-", E.Arith(
+        "+", E.Arith("*", E.ExtractDatePart("year", a), E.Literal(12)),
+        E.ExtractDatePart("month", a)), E.Arith(
+        "+", E.Arith("*", E.ExtractDatePart("year", b), E.Literal(12)),
+        E.ExtractDatePart("month", b)))
+    da = E.ExtractDatePart("day", a)
+    db = E.ExtractDatePart("day", b)
+    both_end = E.And(E.Cmp("==", a, E.LastDay(a)),
+                     E.Cmp("==", b, E.LastDay(b)))
+    same_day = E.Cmp("==", da, db)
+    frac = E.Arith("/", E.Cast(E.Arith("-", da, db), T.FLOAT64),
+                   E.Literal(31.0))
+    return E.Case(((E.Or(same_day, both_end),
+                    E.Cast(whole, T.FLOAT64)),),
+                  E.Arith("+", E.Cast(whole, T.FLOAT64), frac))
+
+
+def current_date() -> Column:
+    import datetime as _dt
+
+    return E.Literal(_dt.date.today())
+
+
+def hour(c: ColumnOrName) -> Column:
+    us = E.Cast(_c(c), T.INT64)
+    day_us = E.Literal(86_400_000_000)
+    in_day = pmod(E.Arith("%", us, day_us), day_us)
+    return E.UnaryMath("floor", E.Arith(
+        "/", in_day, E.Literal(3_600_000_000)))
+
+
+def minute(c: ColumnOrName) -> Column:
+    us = E.Cast(_c(c), T.INT64)
+    day_us = E.Literal(86_400_000_000)
+    in_day = pmod(E.Arith("%", us, day_us), day_us)
+    return E.Arith("%", E.UnaryMath("floor", E.Arith(
+        "/", in_day, E.Literal(60_000_000))), E.Literal(60))
+
+
+def second(c: ColumnOrName) -> Column:
+    us = E.Cast(_c(c), T.INT64)
+    day_us = E.Literal(86_400_000_000)
+    in_day = pmod(E.Arith("%", us, day_us), day_us)
+    return E.Arith("%", E.UnaryMath("floor", E.Arith(
+        "/", in_day, E.Literal(1_000_000))), E.Literal(60))
+
+
+# ---- string breadth ---------------------------------------------------------
+
+
+def initcap(c: ColumnOrName) -> Column:
+    return E.StringTransform("initcap", _c(c))
+
+
+def reverse(c: ColumnOrName) -> Column:
+    return E.StringTransform("reverse", _c(c))
+
+
+def repeat(c: ColumnOrName, n: int) -> Column:
+    return E.StringTransform("repeat", _c(c), (int(n),))
+
+
+def lpad(c: ColumnOrName, length: int, pad: str = " ") -> Column:
+    return E.StringTransform("lpad", _c(c), (int(length), str(pad)))
+
+
+def rpad(c: ColumnOrName, length: int, pad: str = " ") -> Column:
+    return E.StringTransform("rpad", _c(c), (int(length), str(pad)))
+
+
+def translate(c: ColumnOrName, matching: str, replace: str) -> Column:
+    return E.StringTransform("translate", _c(c), (matching, replace))
+
+
+def concat_ws(sep: str, *cols: ColumnOrName) -> Column:
+    parts: list = []
+    for i, c in enumerate(cols):
+        if i:
+            parts.append(E.Literal(sep))
+        parts.append(_c(c))
+    return E.Concat(tuple(parts))
+
+
 # ---- ordering --------------------------------------------------------------
 
 

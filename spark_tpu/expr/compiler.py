@@ -410,8 +410,24 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
 
     if isinstance(expr, E.StringTransform):
         tv = evaluate(expr.child, env)
-        fn = {"upper": str.upper, "lower": str.lower, "trim": str.strip,
-              "ltrim": str.lstrip, "rtrim": str.rstrip}[expr.op]
+        a = expr.args
+        fn = {
+            "upper": str.upper, "lower": str.lower, "trim": str.strip,
+            "ltrim": str.lstrip, "rtrim": str.rstrip,
+            "initcap": lambda s: s.title(),
+            "reverse": lambda s: s[::-1],
+            "repeat": lambda s: s * int(a[0]),
+            "lpad": lambda s: (s[:int(a[0])] if len(s) >= int(a[0])
+                               else (str(a[1]) * int(a[0])
+                                     + s)[-int(a[0]):]),
+            "rpad": lambda s: (s[:int(a[0])] if len(s) >= int(a[0])
+                               else (s + str(a[1]) * int(a[0]))
+                               [:int(a[0])]),
+            # Spark translate: extra match chars (no replacement) delete
+            "translate": lambda s: s.translate(str.maketrans(
+                str(a[0])[: len(str(a[1]))], str(a[1])[: len(str(a[0]))],
+                str(a[0])[len(str(a[1])):])),
+        }[expr.op]
         return _dict_transform(tv, fn, n)
 
     if isinstance(expr, E.StrLength):

@@ -642,11 +642,14 @@ class Pow(Expression):
 
 @dataclass(eq=False, frozen=True)
 class StringTransform(Expression):
-    """upper/lower/trim/ltrim/rtrim — host dictionary transforms
-    (reference: stringExpressions.scala Upper/Lower/StringTrim)."""
+    """upper/lower/trim/ltrim/rtrim/initcap/reverse/repeat/lpad/rpad/
+    translate — host dictionary transforms (reference:
+    stringExpressions.scala Upper/Lower/StringTrim/StringLPad/...).
+    ``args`` carries the op's scalar parameters (pad string, width...)."""
 
     op: str
     child: Expression
+    args: Tuple = ()
 
     def children(self):
         return (self.child,)

@@ -667,6 +667,46 @@ class _ExprParser:
             m = self._int_literal()
             self.expect(")")
             return E.AddMonths(e, m)
+        if name in ("LPAD", "RPAD"):
+            e = self.parse()
+            self.expect(",")
+            ln = self._int_literal()
+            pad = " "
+            if self.accept(","):
+                pad = self._str_literal()
+            self.expect(")")
+            return E.StringTransform(name.lower(), e, (ln, pad))
+        if name == "REPEAT":
+            e = self.parse()
+            self.expect(",")
+            nrep = self._int_literal()
+            self.expect(")")
+            return E.StringTransform("repeat", e, (nrep,))
+        if name == "TRANSLATE":
+            e = self.parse()
+            self.expect(",")
+            m = self._str_literal()
+            self.expect(",")
+            r = self._str_literal()
+            self.expect(")")
+            return E.StringTransform("translate", e, (m, r))
+        if name == "CONCAT_WS":
+            sep = self._str_literal()
+            args = []
+            while self.accept(","):
+                args.append(self.parse())
+            self.expect(")")
+            from spark_tpu.api import functions as F
+
+            return F.concat_ws(sep, *args)
+        if name in _COMPOSED_FUNCTIONS:
+            args = []
+            if not self.accept(")"):
+                args.append(self.parse())
+                while self.accept(","):
+                    args.append(self.parse())
+                self.expect(")")
+            return _COMPOSED_FUNCTIONS[name](*args)
         # session-injected functions (reference:
         # SparkSessionExtensions.injectFunction:344)
         builder = _extension_function(name)
@@ -1166,6 +1206,44 @@ class _NoCatalog:
     def lookup(self, name: str):
         raise SQLParseError(
             f"table or view not found: {name} (no catalog in scope)")
+
+
+def _composed_functions() -> dict:
+    """SQL names for the composition-built functions in api.functions
+    (no dedicated expression nodes; reference: catalyst FunctionRegistry
+    entries that expand to existing expressions)."""
+    from spark_tpu.api import functions as F
+
+    return {
+        "GREATEST": F.greatest, "LEAST": F.least,
+        "IFNULL": F.ifnull, "NVL": F.nvl, "NVL2": F.nvl2,
+        "LOG2": F.log2, "DEGREES": F.degrees, "RADIANS": F.radians,
+        "PMOD": F.pmod,
+        "QUARTER": F.quarter, "DAYOFWEEK": F.dayofweek,
+        "WEEKDAY": F.weekday, "DAYOFYEAR": F.dayofyear,
+        "MONTHS_BETWEEN": F.months_between,
+        "CURRENT_DATE": F.current_date,
+        "HOUR": F.hour, "MINUTE": F.minute, "SECOND": F.second,
+        "INITCAP": F.initcap, "REVERSE": F.reverse,
+    }
+
+
+class _LazyFunctionTable:
+    def __init__(self):
+        self._table = None
+
+    def __contains__(self, name):
+        if self._table is None:
+            self._table = _composed_functions()
+        return name in self._table
+
+    def __getitem__(self, name):
+        if self._table is None:
+            self._table = _composed_functions()
+        return self._table[name]
+
+
+_COMPOSED_FUNCTIONS = _LazyFunctionTable()
 
 
 def _extension_function(name: str):

@@ -69,10 +69,19 @@ class StreamStreamJoinQuery:
                  output_mode: str = "append",
                  checkpoint_dir: Optional[str] = None):
         self._root = root
-        if plan.how != "inner":
+        if plan.how not in ("inner", "left"):
             raise NotImplementedError(
-                f"stream-stream {plan.how} join: only inner joins are "
-                "supported (outer needs matched-bit state)")
+                f"stream-stream {plan.how} join: inner and left outer "
+                "are supported (right/full need symmetric matched-bit "
+                "state)")
+        if plan.how == "left":
+            left_src = L.collect_nodes(plan.left, StreamingSource)[0]
+            if left_src.watermark_col is None:
+                raise NotImplementedError(
+                    "left outer stream-stream join requires a watermark "
+                    "on the left side: null-padded results emit when the "
+                    "watermark proves no match can arrive (reference: "
+                    "StreamingSymmetricHashJoinExec outer-join condition)")
         if output_mode not in ("append", "update"):
             raise NotImplementedError(
                 "stream-stream joins support append mode only "
@@ -157,35 +166,76 @@ class StreamStreamJoinQuery:
     processAllAvailable = process_all_available
 
     def _run_batch(self, batch_id: int, starts, ends) -> None:
+        import pyarrow.compute as pc
+
         new = [self._side_rows(i, starts[i], ends[i]) for i in (0, 1)]
         state = self._load_state(self._batch_id)
+        outer = self._join.how == "left"
+        if outer:
+            # tag left rows with a deterministic-on-replay row id and a
+            # matched bit (reference: the joined-row bookkeeping in
+            # SymmetricHashJoinStateManager KeyWithIndexToValue)
+            n = new[0].num_rows
+            new0 = new[0].append_column(
+                "__lid", pa.array(
+                    [(batch_id << 32) + i for i in range(n)], pa.int64()))
+            new0 = new0.append_column(
+                "__matched", pa.array([False] * n, pa.bool_()))
+            new = [new0, new[1]]
 
         out_parts = []
+        matched_lids: set = set()
         right_all = pa.concat_tables([state[1], new[1]]) \
             if state[1].num_rows else new[1]
+        joinables = []
         if new[0].num_rows and right_all.num_rows:
-            out_parts.append(self._join_tables(new[0], right_all))
+            joinables.append((new[0], right_all))
         if state[0].num_rows and new[1].num_rows:
-            out_parts.append(self._join_tables(state[0], new[1]))
+            joinables.append((state[0], new[1]))
+        for lt, rt in joinables:
+            joined = self._join_tables(
+                lt.drop_columns(["__matched"]) if outer else lt, rt)
+            if outer:
+                matched_lids |= set(
+                    joined.column("__lid").to_pylist())
+                joined = joined.drop_columns(["__lid"])
+            out_parts.append(joined)
         out_parts = [self._apply_above(t) for t in out_parts]
 
-        # grow + watermark-trim state
+        # grow state; flip matched bits
         new_state = [
             pa.concat_tables([state[i], new[i]])
             if state[i].num_rows else new[i]
             for i in (0, 1)
         ]
+        if outer and matched_lids and new_state[0].num_rows:
+            lids = new_state[0].column("__lid").to_pylist()
+            flags = new_state[0].column("__matched").to_pylist()
+            flags = [f or (lid in matched_lids)
+                     for f, lid in zip(flags, lids)]
+            idx = new_state[0].schema.get_field_index("__matched")
+            new_state[0] = new_state[0].set_column(
+                idx, "__matched", pa.array(flags, pa.bool_()))
+
+        # watermark-trim state; evicted unmatched left rows emit
+        # null-padded (this is WHEN outer results appear — the watermark
+        # proves no future right row can match them)
         wm = self._watermark()
         if wm is not None:
-            import pyarrow.compute as pc
-
             for i in (0, 1):
                 wm_col = self._sides[i].watermark_col
                 if wm_col and new_state[i].num_rows > 0 \
                         and wm_col in new_state[i].column_names:
-                    new_state[i] = new_state[i].filter(
-                        pc.greater_equal(new_state[i].column(wm_col),
-                                         pa.scalar(wm)))
+                    keep = pc.greater_equal(
+                        new_state[i].column(wm_col), pa.scalar(wm))
+                    if outer and i == 0:
+                        evicted = new_state[i].filter(pc.invert(keep))
+                        unmatched = evicted.filter(
+                            pc.invert(evicted.column("__matched")))
+                        if unmatched.num_rows:
+                            out_parts.append(self._apply_above(
+                                self._null_padded(unmatched)))
+                    new_state[i] = new_state[i].filter(keep)
 
         self._commit_state(batch_id, new_state)
         self._log.commit(batch_id, watermark=self._max_event)
@@ -194,6 +244,22 @@ class StreamStreamJoinQuery:
             if t.num_rows:
                 self._appended.append(t)
         self._register_sink()
+
+    def _null_padded(self, left_rows: pa.Table) -> pa.Table:
+        """Unmatched left rows shaped like the join output: left columns
+        + all-null right columns."""
+        from spark_tpu.io.datasource import _pa_schema_from_schema
+
+        left_clean = left_rows.drop_columns(["__lid", "__matched"])
+        n = left_clean.num_rows
+        out_schema = _pa_schema_from_schema(self._join.schema)
+        arrays = []
+        for f in out_schema:
+            if f.name in left_clean.column_names:
+                arrays.append(left_clean.column(f.name).cast(f.type))
+            else:
+                arrays.append(pa.nulls(n, f.type))
+        return pa.Table.from_arrays(arrays, schema=out_schema)
 
     def _watermark(self) -> Optional[int]:
         """MIN of per-side watermarks (a row may still find matches from

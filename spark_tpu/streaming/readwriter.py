@@ -71,9 +71,15 @@ class DataStreamWriter:
         if self._format != "memory":
             raise NotImplementedError(
                 f"streaming sink {self._format!r} (memory only)")
+        from spark_tpu.streaming.groups import (FlatMapGroupsWithState,
+                                                GroupStateQuery)
         from spark_tpu.streaming.join import (StreamStreamJoinQuery,
                                               find_streaming_join)
 
+        if isinstance(self._df._plan, FlatMapGroupsWithState):
+            return GroupStateQuery(
+                self._df._session, self._df._plan, self._name,
+                self._output_mode, self._checkpoint)
         join = find_streaming_join(self._df._plan)
         if join is not None:
             return StreamStreamJoinQuery(

@@ -260,3 +260,38 @@ class Schema:
     def __repr__(self) -> str:
         inner = ", ".join(f"{f.name}:{f.dtype}" for f in self.fields)
         return f"schema<{inner}>"
+
+
+def parse_ddl_schema(ddl: str) -> Schema:
+    """Parse a simple DDL schema string: ``"name type, name type"``
+    (reference: StructType.fromDDL; the subset pyspark users pass to
+    applyInPandas* — no nested types)."""
+    mapping = {
+        "boolean": BOOLEAN, "bool": BOOLEAN,
+        "tinyint": INT8, "byte": INT8,
+        "smallint": INT16, "short": INT16,
+        "int": INT32, "integer": INT32,
+        "bigint": INT64, "long": INT64,
+        "float": FLOAT32, "real": FLOAT32,
+        "double": FLOAT64,
+        "string": STRING, "varchar": STRING,
+        "date": DATE, "timestamp": TIMESTAMP,
+    }
+    fields = []
+    for part in ddl.split(","):
+        toks = part.strip().split()
+        if len(toks) < 2:
+            raise ValueError(f"bad DDL field: {part!r}")
+        name, type_name = toks[0], toks[1].lower()
+        base = type_name.split("(")[0]
+        if base == "decimal":
+            inner = type_name[type_name.index("(") + 1:
+                              type_name.index(")")].split(",") \
+                if "(" in type_name else ["10", "0"]
+            dt: DataType = DecimalType(int(inner[0]), int(inner[1]))
+        elif base in mapping:
+            dt = mapping[base]
+        else:
+            raise ValueError(f"unsupported DDL type: {type_name!r}")
+        fields.append(Field(name, dt, nullable=True))
+    return Schema(tuple(fields))

@@ -158,3 +158,117 @@ def test_floor_large_int_identity(spark):
     big = (1 << 60) + 1
     df = spark.createDataFrame([{"i": big}])
     assert df.select(F.floor("i").alias("f")).collect()[0].f == big
+
+
+# ---- composition batch (greatest/least, datetime parts, pads...) ----------
+
+
+def test_greatest_least(spark):
+    rows = spark.sql(
+        "select greatest(n, 7) as g, least(x, 0.0) as l from exprs"
+    ).collect()
+    assert sorted(r["g"] for r in rows) == [7, 9, 16]
+    assert sorted(r["l"] for r in rows) == [-3.21, 0.0, 0.0]
+
+
+def test_greatest_skips_nulls(spark):
+    import pyarrow as pa
+
+    df = spark.createDataFrame(pa.table({
+        "a": pa.array([1, None, None], pa.int64()),
+        "b": pa.array([None, 5, None], pa.int64())}))
+    df.createOrReplaceTempView("gn")
+    rows = spark.sql("select greatest(a, b) as g from gn").collect()
+    assert [r["g"] for r in rows] == [1, 5, None]
+
+
+def test_ifnull_nvl2(spark):
+    import pyarrow as pa
+
+    df = spark.createDataFrame(pa.table({
+        "a": pa.array([None, 3], pa.int64())}))
+    df.createOrReplaceTempView("nv")
+    rows = spark.sql(
+        "select ifnull(a, -1) as i, nvl2(a, 100, 200) as v from nv"
+    ).collect()
+    assert [(r["i"], r["v"]) for r in rows] == [(-1, 200), (3, 100)]
+
+
+def test_datetime_parts(spark):
+    import datetime
+
+    rows = spark.sql("""
+      select quarter(d) as q, dayofweek(d) as dw, weekday(d) as wd,
+             dayofyear(d) as dy, d from exprs order by d
+    """).collect()
+    for r in rows:
+        d = r["d"]
+        assert r["q"] == (d.month - 1) // 3 + 1
+        # Spark: 1=Sunday..7=Saturday; python weekday(): 0=Monday
+        assert r["dw"] == (d.weekday() + 1) % 7 + 1
+        assert r["wd"] == d.weekday()
+        assert r["dy"] == d.timetuple().tm_yday
+
+
+def test_months_between(spark):
+    rows = spark.sql("""
+      select months_between(date '1997-02-28', date '1996-10-30') as a,
+             months_between(date '1997-02-28', date '1996-11-30') as b,
+             months_between(date '1997-03-15', date '1997-01-15') as c
+    """).collect()[0]
+    # 1996-10-30 -> 1997-02-28: 4 months minus 2/31 (Spark: 3.93548387)
+    assert abs(rows["a"] - (4 - 2 / 31)) < 1e-9
+    # both month-ends: whole number
+    assert rows["b"] == 3.0
+    assert rows["c"] == 2.0
+
+
+def test_math_breadth2(spark):
+    import math
+
+    rows = spark.sql(
+        "select log2(n) as l2, degrees(x) as dg, pmod(-7, 3) as pm "
+        "from exprs").collect()
+    assert sorted(r["l2"] for r in rows) == [2.0, pytest.approx(
+        math.log2(9)), 4.0]
+    assert rows[0]["pm"] == 2  # Spark pmod(-7, 3) == 2
+
+
+def test_string_pads(spark):
+    rows = spark.sql("""
+      select lpad(trim(s), 10, '*') as lp, rpad(trim(s), 4, 'x') as rp,
+             reverse(trim(s)) as rv, initcap(trim(s)) as ic,
+             repeat('ab', 3) as rpt,
+             translate(s, 'lo', 'LO') as tr
+      from exprs where s = 'WORLD'
+    """).collect()[0]
+    assert rows["lp"] == "*****WORLD"
+    assert rows["rp"] == "WORL"
+    assert rows["rv"] == "DLROW"
+    assert rows["ic"] == "World"
+    assert rows["rpt"] == "ababab"
+    assert rows["tr"] == "WORLD"
+
+
+def test_concat_ws_translate(spark):
+    rows = spark.sql(
+        "select concat_ws('-', trim(s), 'z') as c, "
+        "translate('banana', 'an', 'AN') as t from exprs limit 1"
+    ).collect()[0]
+    assert rows["c"].endswith("-z")
+    assert rows["t"] == "bANANA"
+
+
+def test_timestamp_parts(spark):
+    import datetime
+
+    import pyarrow as pa
+
+    ts = datetime.datetime(2001, 7, 4, 13, 45, 30)
+    df = spark.createDataFrame(pa.table({
+        "t": pa.array([ts], pa.timestamp("us"))}))
+    df.createOrReplaceTempView("tsv")
+    r = spark.sql(
+        "select hour(t) as h, minute(t) as m, second(t) as s from tsv"
+    ).collect()[0]
+    assert (r["h"], r["m"], r["s"]) == (13, 45, 30)

@@ -98,11 +98,45 @@ def test_checkpoint_restart(spark, tmp_path):
     assert rows == [(1, 10, 99)]
 
 
-def test_outer_join_rejected_loudly(spark):
+def test_unsupported_outer_shapes_rejected_loudly(spark):
     left, right, ldf, rdf = _sources(spark)
-    with pytest.raises(NotImplementedError, match="inner"):
+    with pytest.raises(NotImplementedError, match="matched-bit"):
+        ldf.join(rdf, on="k", how="full").writeStream \
+            .outputMode("append").start()
+    # left outer without a left-side watermark cannot ever emit nulls
+    with pytest.raises(NotImplementedError, match="watermark"):
         ldf.join(rdf, on="k", how="left").writeStream \
             .outputMode("append").start()
+
+
+def test_left_outer_join_emits_on_eviction(spark):
+    left = MemoryStream(pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                                   ("lv", pa.int64())]))
+    right = MemoryStream(pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                                    ("rv", pa.int64())]))
+    ldf = spark.readStream.load(left).withWatermark("t", 10)
+    rdf = spark.readStream.load(right).withWatermark("t", 10).drop("t")
+    q = ldf.join(rdf, on="k", how="left").writeStream \
+        .outputMode("append").queryName("sslo").start()
+
+    left.add_data([{"t": 0, "k": 1, "lv": 10},
+                   {"t": 0, "k": 2, "lv": 20}])
+    right.add_data([{"t": 0, "k": 1, "rv": 100}])
+    q.processAllAvailable()
+    rows = {(r["k"], r["lv"], r["rv"])
+            for r in spark.sql("select k, lv, rv from sslo").collect()}
+    assert rows == {(1, 10, 100)}  # k=2 pending: might still match
+
+    # advance the watermark far: k=2 evicts unmatched -> null-padded
+    left.add_data([{"t": 100, "k": 9, "lv": 90}])
+    right.add_data([{"t": 100, "k": 9, "rv": 900}])
+    q.processAllAvailable()
+    rows = {(r["k"], r["lv"], r["rv"])
+            for r in spark.sql("select k, lv, rv from sslo").collect()}
+    assert (2, 20, None) in rows
+    assert (9, 90, 900) in rows
+    # matched rows never emit null-padded duplicates
+    assert (1, 10, None) not in rows
 
 
 def test_join_with_projection_below(spark):
