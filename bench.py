@@ -132,10 +132,20 @@ def main():
 
     full = {}
     if FULL:
+        import sys
+
+        budget_s = float(os.environ.get("BENCH_FULL_BUDGET", "1800"))
+        sweep_t0 = time.time()
         for qnum in sorted(QUERIES):
             if qnum in results:
                 full[qnum] = results[qnum]["ms"]
                 continue
+            elapsed = time.time() - sweep_t0
+            if elapsed > budget_s:
+                full[qnum] = "skipped: sweep budget exhausted"
+                continue
+            print(f"[bench] q{qnum} (sweep {elapsed:.0f}s)",
+                  file=sys.stderr, flush=True)
             try:
                 df = spark.sql(QUERIES[qnum])
                 df.collect()  # warm-up 1: compile + stats

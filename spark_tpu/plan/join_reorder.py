@@ -98,6 +98,77 @@ def estimate_rows(plan: L.LogicalPlan) -> float:
     return max((estimate_rows(c) for c in children), default=1.0)
 
 
+# ---- NDV (distinct-count) estimation ---------------------------------------
+#
+# The reference CBO reads column NDVs from ANALYZE-collected stats
+# (statsEstimation/JoinEstimation.scala); here they come from the data
+# itself: one projected-column scan per (source, column), memoized on
+# the FileSource. |T join R on k| = |T|*|R| / max(ndv_T(k), ndv_R(k)) —
+# without this, a many-to-many key (e.g. TPC-H q5 joining supplier to
+# customer on nationkey, 25 distinct values) looks identical to a PK-FK
+# join and the greedy happily materializes the junk-pair blowup.
+
+_REL_NDV_CAP = 1 << 22  # device relations larger than this: skip fetch
+
+
+def _atom_ndv(atom: L.LogicalPlan, expr: E.Expression) -> Optional[float]:
+    """Approximate distinct count of a join-key expression on an atom;
+    None = unknown (callers fall back to rows, i.e. assume unique)."""
+    inner = E.strip_alias(expr)
+    if not isinstance(inner, E.Col):
+        return None
+    name = inner.col_name
+    node = atom
+    while True:
+        if isinstance(node, (L.Filter, L.SubqueryAlias, L.Limit,
+                             L.Sample, L.Distinct, L.Sort)):
+            node = node.children()[0]
+            continue
+        if isinstance(node, L.Project):
+            # follow plain renames only
+            match = [e for e in node.exprs if e.name == name]
+            if len(match) != 1:
+                return None
+            src = E.strip_alias(match[0])
+            if not isinstance(src, E.Col):
+                return None
+            name = src.col_name
+            node = node.child
+            continue
+        break
+    if isinstance(node, L.UnresolvedScan):
+        try:
+            return float(_scan_ndv(node.source, name))
+        except Exception:
+            return None
+    if isinstance(node, L.Relation):
+        if node.batch.capacity > _REL_NDV_CAP \
+                or name not in node.batch.schema:
+            return None
+        try:
+            import numpy as np
+
+            cd = node.batch.column(name)
+            return float(np.unique(np.asarray(cd.data)).size)
+        except Exception:
+            return None
+    if isinstance(node, L.Range):
+        return float(node.num_rows)
+    return None
+
+
+def _scan_ndv(source, column: str) -> int:
+    cache = getattr(source, "_ndv_cache", None)
+    if cache is None:
+        cache = source._ndv_cache = {}
+    if column not in cache:
+        import pyarrow.compute as pc
+
+        tbl = source._open().to_table(columns=[column])
+        cache[column] = int(pc.count_distinct(tbl.column(column)).as_py())
+    return cache[column]
+
+
 # ---- cluster flattening -----------------------------------------------------
 
 
@@ -172,6 +243,32 @@ def _reorder_cluster(root: L.Join) -> Optional[L.LogicalPlan]:
     atoms = [reorder_joins(a) for a in atoms]
     est = [estimate_rows(a) for a in atoms]
 
+    # per-edge NDVs (memoized scans); None -> assume unique on that atom
+    edge_ndv = [(_atom_ndv(atoms[i], ki), _atom_ndv(atoms[j], kj))
+                for (i, j, ki, kj) in edges]
+
+    def join_size(t_est: float, joined: set, c: int) -> Tuple[float, int]:
+        """(estimated output size, 0 if some edge is ~PK-FK else 1).
+        size = t*r / max_k(max(ndv_t, ndv_c)) over the connecting keys;
+        unknown NDV counts as the side's row estimate (unique)."""
+        denom = 1.0
+        fkish = 1
+        for e, (i, j, _, _) in enumerate(edges):
+            ndv_i, ndv_j = edge_ndv[e]
+            if i in joined and j == c:
+                nt, nc, t_atom, c_atom = ndv_i, ndv_j, i, j
+            elif j in joined and i == c:
+                nt, nc, t_atom, c_atom = ndv_j, ndv_i, j, i
+            else:
+                continue
+            nt = nt if nt is not None else est[t_atom]
+            nc = nc if nc is not None else est[c_atom]
+            denom = max(denom, max(nt, nc))
+            # PK-FK: one side's key is ~unique on its atom
+            if nc >= 0.8 * est[c_atom] or nt >= 0.8 * est[t_atom]:
+                fkish = 0
+        return t_est * est[c] / denom, fkish
+
     n = len(atoms)
     start = min(range(n), key=lambda i: est[i])
     joined = {start}
@@ -187,8 +284,14 @@ def _reorder_cluster(root: L.Join) -> Optional[L.LogicalPlan]:
         if not connected:
             # disconnected components despite keys: out of scope
             return None
-        # cost of joining candidate c next = estimated output size
-        c = min(connected, key=lambda x: (max(tree_est, est[x]), est[x]))
+        # cost of joining candidate c next: PK-FK edges first, then the
+        # smallest estimated output, then the smaller input
+        def cost(x: int):
+            size, non_fk = join_size(tree_est, joined, x)
+            return (non_fk, size, est[x])
+
+        c = min(connected, key=cost)
+        new_est = join_size(tree_est, joined, c)[0]
         lkeys: List[E.Expression] = []
         rkeys: List[E.Expression] = []
         for (i, j, ki, kj) in edges:
@@ -200,7 +303,7 @@ def _reorder_cluster(root: L.Join) -> Optional[L.LogicalPlan]:
                 rkeys.append(ki)
         tree = L.Join(tree, atoms[c], "inner",
                       tuple(lkeys), tuple(rkeys), None)
-        tree_est = max(tree_est, est[c])
+        tree_est = max(new_est, 1.0)
         joined.add(c)
 
     if conds:
