@@ -69,11 +69,34 @@ class StreamStreamJoinQuery:
                  output_mode: str = "append",
                  checkpoint_dir: Optional[str] = None):
         self._root = root
+        if plan.how == "right":
+            # right outer = sides swapped left outer (the operators
+            # above — always a Project for USING joins — are reapplied
+            # per batch and restore column order/selection)
+            from spark_tpu.expr import expressions as E
+
+            lnames = set(plan.left.schema.names)
+            rnames = set(plan.right.schema.names)
+            if lnames & rnames and (root is plan
+                                    or plan.condition is not None):
+                raise NotImplementedError(
+                    "right outer stream join with colliding column "
+                    "names and no projection above: '#2' dedup names "
+                    "shift under the side swap")
+            orig = plan
+            orig_names = plan.schema.names
+            plan = L.Join(plan.right, plan.left, "left",
+                          plan.right_keys, plan.left_keys,
+                          plan.condition)
+            if root is orig:
+                # bare-root: restore the right-join column order
+                self._root = L.Project(
+                    tuple(E.Col(n) for n in orig_names), plan)
         if plan.how not in ("inner", "left"):
             raise NotImplementedError(
-                f"stream-stream {plan.how} join: inner and left outer "
-                "are supported (right/full need symmetric matched-bit "
-                "state)")
+                f"stream-stream {plan.how} join: inner, left and right "
+                "outer are supported (full outer needs symmetric "
+                "matched-bit state on both sides)")
         if plan.how == "left":
             left_src = L.collect_nodes(plan.left, StreamingSource)[0]
             if left_src.watermark_col is None:

@@ -150,3 +150,31 @@ def test_join_with_projection_below(spark):
     rows = [tuple(r) for r in spark.sql(
         "select k, value, rv from ssj5").collect()]
     assert rows == [(3, 2, 5)]
+
+
+def test_right_outer_join_via_swap(spark):
+    left = MemoryStream(pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                                   ("lv", pa.int64())]))
+    right = MemoryStream(pa.schema([("t2", pa.int64()), ("k", pa.int64()),
+                                    ("rv", pa.int64())]))
+    ldf = spark.readStream.load(left).withWatermark("t", 10).drop("t")
+    rdf = spark.readStream.load(right).withWatermark("t2", 10)
+    q = ldf.join(rdf, on="k", how="right").writeStream \
+        .outputMode("append").queryName("ssro").start()
+
+    left.add_data([{"t": 0, "k": 1, "lv": 10}])
+    right.add_data([{"t2": 0, "k": 1, "rv": 100},
+                    {"t2": 0, "k": 2, "rv": 200}])
+    q.processAllAvailable()
+    rows = {(r["k"], r["lv"], r["rv"])
+            for r in spark.sql("select k, lv, rv from ssro").collect()}
+    assert rows == {(1, 10, 100)}  # k=2 right row pending
+
+    # advance both watermarks: unmatched RIGHT row emits null-padded
+    left.add_data([{"t": 100, "k": 9, "lv": 90}])
+    right.add_data([{"t2": 100, "k": 9, "rv": 900}])
+    q.processAllAvailable()
+    rows = {(r["k"], r["lv"], r["rv"])
+            for r in spark.sql("select k, lv, rv from ssro").collect()}
+    assert (2, None, 200) in rows
+    assert (9, 90, 900) in rows
