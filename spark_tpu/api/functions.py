@@ -369,7 +369,7 @@ def nvl2(a: ColumnOrName, b: ColumnOrName, c: ColumnOrName) -> Column:
 
 def nullif(a: ColumnOrName, b: ColumnOrName) -> Column:
     x = _c(a)
-    return E.Case(((E.Cmp("==", x, _c(b)), E.Literal(None, T.BOOLEAN)),), x)
+    return E.Case(((E.Cmp("==", x, _c(b)), E.NullOf(x)),), x)
 
 
 def negative(c: ColumnOrName) -> Column:
@@ -518,12 +518,7 @@ def translate(c: ColumnOrName, matching: str, replace: str) -> Column:
 
 
 def concat_ws(sep: str, *cols: ColumnOrName) -> Column:
-    parts: list = []
-    for i, c in enumerate(cols):
-        if i:
-            parts.append(E.Literal(sep))
-        parts.append(_c(c))
-    return E.Concat(tuple(parts))
+    return E.ConcatWs(str(sep), tuple(_c(c) for c in cols))
 
 
 # ---- ordering --------------------------------------------------------------

@@ -164,7 +164,12 @@ class DataFrameStatFunctions:
         return (s["xy"] - s["x"] * s["y"] / n) / (n - 1)
 
     def _moments(self, c1: str, c2: str) -> Dict[str, float]:
-        df = self._df
+        # pairwise deletion (reference: StatFunctions computes co-moments
+        # over rows where BOTH columns are present): per-column null
+        # skipping would mix Sum(x) over x-rows with Count over xy-rows
+        # and silently corrupt corr/cov when either column has nulls
+        df = self._df.filter(E.And(E.Not(E.IsNull(E.Col(c1))),
+                                   E.Not(E.IsNull(E.Col(c2)))))
         x = E.Cast(E.Col(c1), T.FLOAT64)
         y = E.Cast(E.Col(c2), T.FLOAT64)
         agg = df.agg(

@@ -237,6 +237,11 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             return TV(jnp.zeros((n,), dtype=jnp.bool_), None, T.BOOLEAN, None)
         return TV(~tv.validity, None, T.BOOLEAN, None)
 
+    if isinstance(expr, E.NullOf):
+        tv = evaluate(expr.like, env)
+        return TV(tv.data, jnp.zeros((n,), dtype=jnp.bool_), tv.dtype,
+                  tv.dictionary)
+
     if isinstance(expr, E.In):
         tv = evaluate(expr.child, env)
         if isinstance(tv.dtype, T.StringType):
@@ -313,6 +318,40 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             codes = codes * len(d) + c
             validity = _and_validity(validity, tv.validity)
         return TV(jnp.asarray(remap)[codes], validity, T.STRING, new_dict)
+
+    if isinstance(expr, E.ConcatWs):
+        tvs = [evaluate(a, env) for a in expr.args]
+        for tv in tvs:
+            if not isinstance(tv.dtype, T.StringType):
+                raise NotImplementedError("CONCAT_WS supports strings only")
+        # a nullable input's dictionary gains a null sentinel (None);
+        # per-row codes point at it where the input is null, so
+        # null-skipping is a pure dictionary-table property
+        dicts = [tuple(tv.dictionary or ("",))
+                 + ((None,) if tv.validity is not None else ())
+                 for tv in tvs]
+        total = 1
+        for d in dicts:
+            total *= len(d)
+        if total > (1 << 20):
+            raise NotImplementedError(
+                f"CONCAT_WS dictionary product too large ({total})")
+        combo: list = [()]
+        for d in dicts:
+            combo = [t + (s,) for t in combo for s in d]
+        joined = [expr.sep.join(p for p in t if p is not None)
+                  for t in combo]
+        new_dict = tuple(sorted(set(joined)))
+        pos = {s: i for i, s in enumerate(new_dict)}
+        remap = np.array([pos[s] for s in joined], dtype=np.int32)
+        codes = jnp.zeros((n,), dtype=jnp.int32)
+        for tv, d in zip(tvs, dicts):
+            c = (tv.data if len(tv.dictionary or ())
+                 else jnp.zeros((n,), jnp.int32))
+            if tv.validity is not None:
+                c = jnp.where(tv.validity, c, len(d) - 1)
+            codes = codes * len(d) + c
+        return TV(jnp.asarray(remap)[codes], None, T.STRING, new_dict)
 
     if isinstance(expr, E.Substring):
         tv = evaluate(expr.child, env)
@@ -417,10 +456,15 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             "initcap": lambda s: s.title(),
             "reverse": lambda s: s[::-1],
             "repeat": lambda s: s * int(a[0]),
-            "lpad": lambda s: (s[:int(a[0])] if len(s) >= int(a[0])
-                               else (str(a[1]) * int(a[0])
-                                     + s)[-int(a[0]):]),
-            "rpad": lambda s: (s[:int(a[0])] if len(s) >= int(a[0])
+            # pad cycles from its START (reference StringLPad: lpad
+            # ('abc', 6, 'xy') = 'xyxabc', not tail-aligned 'yxyabc');
+            # non-positive length = '' (UTF8String.lpad substring(0, len))
+            "lpad": lambda s: (s[:max(0, int(a[0]))]
+                               if len(s) >= int(a[0])
+                               else (str(a[1]) * int(a[0]))
+                               [:int(a[0]) - len(s)] + s),
+            "rpad": lambda s: (s[:max(0, int(a[0]))]
+                               if len(s) >= int(a[0])
                                else (s + str(a[1]) * int(a[0]))
                                [:int(a[0])]),
             # Spark translate: extra match chars (no replacement) delete

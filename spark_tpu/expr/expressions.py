@@ -384,6 +384,27 @@ class IsNull(Expression):
 
 
 @dataclass(eq=False, frozen=True)
+class NullOf(Expression):
+    """NULL typed like ``like`` (reference: Literal(null, child.dataType)
+    inside NullIf's If rewrite, nullExpressions.scala). Keeps Case's
+    common-type inference working where an untyped null literal cannot."""
+
+    like: Expression
+
+    def children(self):
+        return (self.like,)
+
+    def data_type(self, schema):
+        return self.like.data_type(schema)
+
+    def nullable(self, schema):
+        return True
+
+    def __str__(self):
+        return f"NULL_OF({self.like})"
+
+
+@dataclass(eq=False, frozen=True)
 class In(Expression):
     child: Expression
     values: Tuple[Any, ...]  # python literals
@@ -568,6 +589,30 @@ class Concat(Expression):
 
     def __str__(self):
         return f"CONCAT({', '.join(map(str, self.args))})"
+
+
+@dataclass(eq=False, frozen=True)
+class ConcatWs(Expression):
+    """concat_ws(sep, ...): separator-joined concat that SKIPS null
+    arguments (reference: ConcatWs, stringExpressions.scala — null
+    inputs drop out with their separator; result is never null unless
+    the separator is). Evaluated over host dictionaries like Concat,
+    with a per-input null sentinel absorbed into the mixed radix."""
+
+    sep: str
+    args: Tuple[Expression, ...]
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def nullable(self, schema):
+        return False
+
+    def __str__(self):
+        return f"CONCAT_WS({self.sep!r}, {', '.join(map(str, self.args))})"
 
 
 @dataclass(eq=False, frozen=True)

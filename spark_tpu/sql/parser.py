@@ -646,8 +646,7 @@ class _ExprParser:
             self.expect(",")
             b = self.parse()
             self.expect(")")
-            return E.Case(((E.Cmp("==", a, b), E.Literal(None, T.BOOLEAN)),),
-                          a)
+            return E.Case(((E.Cmp("==", a, b), E.NullOf(a)),), a)
         if name == "CONCAT":
             args = [self.parse()]
             while self.accept(","):

@@ -272,3 +272,53 @@ def test_timestamp_parts(spark):
         "select hour(t) as h, minute(t) as m, second(t) as s from tsv"
     ).collect()[0]
     assert (r["h"], r["m"], r["s"]) == (13, 45, 30)
+
+
+def test_nullif_typed(spark):
+    """nullif on non-boolean operands; NULL arm is typed to the operand
+    (reference: NullIf → If(EqualTo(l, r), Literal(null, l.dataType), l))."""
+    import pyarrow as pa
+
+    d = spark.createDataFrame(pa.table({
+        "a": pa.array([1, 2, 2, None], pa.int64()),
+        "s": pa.array(["x", "y", "x", None]),
+    }))
+    out = d.select(F.nullif("a", F.lit(2)).alias("v")).collect()
+    assert [r["v"] for r in out] == [1, None, None, None]
+    out2 = d.select(F.nullif("s", F.lit("x")).alias("v")).collect()
+    assert [r["v"] for r in out2] == [None, "y", None, None]
+    assert spark.sql("select nullif(1, 2) as v").collect()[0]["v"] == 1
+
+
+def test_lpad_multichar_head_aligned(spark):
+    """lpad cycles the pad from its START (reference StringLPad:
+    lpad('abc', 6, 'xy') = 'xyxabc')."""
+    import pyarrow as pa
+
+    d = spark.createDataFrame(pa.table({"s": pa.array(["abc", "hello!"])}))
+    out = d.select(F.lpad("s", 6, "xy").alias("v")).collect()
+    assert [r["v"] for r in out] == ["xyxabc", "hello!"]
+    out2 = d.select(F.rpad("s", 6, "xy").alias("v")).collect()
+    assert [r["v"] for r in out2] == ["abcxyx", "hello!"]
+    # non-positive length -> '' (UTF8String.lpad substring(0, len))
+    out3 = d.select(F.lpad("s", -1, "x").alias("v"),
+                    F.rpad("s", 0, "x").alias("w")).collect()
+    assert [r["v"] for r in out3] == ["", ""]
+    assert [r["w"] for r in out3] == ["", ""]
+
+
+def test_concat_ws_skips_nulls(spark):
+    """concat_ws skips null arguments with their separators (reference:
+    ConcatWs, stringExpressions.scala) — result is never null."""
+    import pyarrow as pa
+
+    d = spark.createDataFrame(pa.table({
+        "a": pa.array(["x", None, "p"]),
+        "b": pa.array(["y", "q", None]),
+        "c": pa.array([None, "z", None]),
+    }))
+    out = d.select(F.concat_ws("-", "a", "b", "c").alias("v")).collect()
+    assert [r["v"] for r in out] == ["x-y", "q-z", "p"]
+    d.createOrReplaceTempView("cws")
+    out2 = spark.sql("select concat_ws('-', a, b, c) as v from cws").collect()
+    assert [r["v"] for r in out2] == ["x-y", "q-z", "p"]

@@ -95,3 +95,16 @@ def test_describe(spark):
     assert float(rows["mean"]) == 2.5
     assert abs(float(rows["stddev"]) - 1.2909944487358056) < 1e-9
     assert float(rows["min"]) == 1.0 and float(rows["max"]) == 4.0
+
+
+def test_corr_cov_pairwise_null_deletion(spark):
+    """corr/cov must use pairwise deletion (rows where BOTH columns are
+    non-null), not per-column null skipping (reference:
+    StatFunctions.pearsonCorrelation / calculateCov co-moments)."""
+    d = spark.createDataFrame(pa.table({
+        "x": pa.array([1.0, 2.0, 3.0, None, 100.0]),
+        "y": pa.array([2.0, 4.0, 6.0, 50.0, None]),
+    }))
+    # surviving pairs: (1,2),(2,4),(3,6) — perfectly correlated
+    assert abs(d.stat.corr("x", "y") - 1.0) < 1e-12
+    assert abs(d.stat.cov("x", "y") - 2.0) < 1e-12  # cov([1,2,3],[2,4,6])
