@@ -148,6 +148,44 @@ def _literal_tv(value, dtype: DataType, n: int) -> TV:
     return TV(data, None, dtype, None)
 
 
+def _dict_product(name: str, tvs: List[TV], n: int, null_sentinel: bool,
+                  join) -> TV:
+    """Shared core of CONCAT/CONCAT_WS: cartesian dictionary product with
+    mixed-radix code combination, then re-sort/dedup of the output
+    dictionary. ``join`` maps one tuple of per-input dictionary entries
+    (None = null when ``null_sentinel``) to an output string. With
+    ``null_sentinel``, each nullable input's dictionary gains a trailing
+    None entry its null rows are re-coded to."""
+    for tv in tvs:
+        if not isinstance(tv.dtype, T.StringType):
+            raise NotImplementedError(f"{name} supports strings only")
+    dicts = [tuple(tv.dictionary or ("",))
+             + ((None,) if null_sentinel and tv.validity is not None
+                else ())
+             for tv in tvs]
+    total = 1
+    for d in dicts:
+        total *= len(d)
+    if total > (1 << 20):
+        raise NotImplementedError(
+            f"{name} dictionary product too large ({total})")
+    combo: List[tuple] = [()]
+    for d in dicts:
+        combo = [t + (s,) for t in combo for s in d]
+    joined = [join(t) for t in combo]
+    new_dict = tuple(sorted(set(joined)))
+    pos = {s: i for i, s in enumerate(new_dict)}
+    remap = np.array([pos[s] for s in joined], dtype=np.int32)
+    codes = jnp.zeros((n,), dtype=jnp.int32)
+    for tv, d in zip(tvs, dicts):
+        c = (tv.data if len(tv.dictionary or ())
+             else jnp.zeros((n,), jnp.int32))
+        if null_sentinel and tv.validity is not None:
+            c = jnp.where(tv.validity, c, len(d) - 1)
+        codes = codes * len(d) + c
+    return TV(jnp.asarray(remap)[codes], None, T.STRING, new_dict)
+
+
 def evaluate(expr: E.Expression, env: Env) -> TV:
     """Evaluate an expression to a TV. Called inside jit traces."""
     n = env.capacity
@@ -292,66 +330,22 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
         return TV(res, tv.validity, T.BOOLEAN, None)
 
     if isinstance(expr, E.Concat):
+        # null propagates (unlike CONCAT_WS): plain cartesian product
         tvs = [evaluate(a, env) for a in expr.args]
-        for tv in tvs:
-            if not isinstance(tv.dtype, T.StringType):
-                raise NotImplementedError("CONCAT supports strings only")
-        total = 1
-        for tv in tvs:
-            total *= max(1, len(tv.dictionary or ()))
-        if total > (1 << 20):
-            raise NotImplementedError(
-                f"CONCAT dictionary product too large ({total})")
-        # cartesian dictionary, mixed-radix codes; then re-sort/dedup
-        dicts = [tv.dictionary or ("",) for tv in tvs]
-        combo: list = [""]
-        for d in dicts:
-            combo = [a + b for a in combo for b in d]
-        new_dict = tuple(sorted(set(combo)))
-        pos = {s: i for i, s in enumerate(new_dict)}
-        remap = np.array([pos[s] for s in combo], dtype=np.int32)
-        codes = jnp.zeros((n,), dtype=jnp.int32)
+        out = _dict_product(
+            "CONCAT", tvs, n, null_sentinel=False,
+            join=lambda t: "".join(t))
         validity = None
-        for tv, d in zip(tvs, dicts):
-            c = tv.data if len(tv.dictionary or ()) else jnp.zeros(
-                (n,), jnp.int32)
-            codes = codes * len(d) + c
+        for tv in tvs:
             validity = _and_validity(validity, tv.validity)
-        return TV(jnp.asarray(remap)[codes], validity, T.STRING, new_dict)
+        return TV(out.data, validity, T.STRING, out.dictionary)
 
     if isinstance(expr, E.ConcatWs):
+        # null inputs are SKIPPED with their separator; result non-null
         tvs = [evaluate(a, env) for a in expr.args]
-        for tv in tvs:
-            if not isinstance(tv.dtype, T.StringType):
-                raise NotImplementedError("CONCAT_WS supports strings only")
-        # a nullable input's dictionary gains a null sentinel (None);
-        # per-row codes point at it where the input is null, so
-        # null-skipping is a pure dictionary-table property
-        dicts = [tuple(tv.dictionary or ("",))
-                 + ((None,) if tv.validity is not None else ())
-                 for tv in tvs]
-        total = 1
-        for d in dicts:
-            total *= len(d)
-        if total > (1 << 20):
-            raise NotImplementedError(
-                f"CONCAT_WS dictionary product too large ({total})")
-        combo: list = [()]
-        for d in dicts:
-            combo = [t + (s,) for t in combo for s in d]
-        joined = [expr.sep.join(p for p in t if p is not None)
-                  for t in combo]
-        new_dict = tuple(sorted(set(joined)))
-        pos = {s: i for i, s in enumerate(new_dict)}
-        remap = np.array([pos[s] for s in joined], dtype=np.int32)
-        codes = jnp.zeros((n,), dtype=jnp.int32)
-        for tv, d in zip(tvs, dicts):
-            c = (tv.data if len(tv.dictionary or ())
-                 else jnp.zeros((n,), jnp.int32))
-            if tv.validity is not None:
-                c = jnp.where(tv.validity, c, len(d) - 1)
-            codes = codes * len(d) + c
-        return TV(jnp.asarray(remap)[codes], None, T.STRING, new_dict)
+        return _dict_product(
+            "CONCAT_WS", tvs, n, null_sentinel=True,
+            join=lambda t: expr.sep.join(p for p in t if p is not None))
 
     if isinstance(expr, E.Substring):
         tv = evaluate(expr.child, env)

@@ -1134,12 +1134,34 @@ class JoinExec(PhysicalPlan):
         sized_cap = self.adaptive[3] if len(self.adaptive) > 3 else None
         lcomb, lvalid, rcomb, rvalid, hashed, prepped = self._traced_keys(
             lpipe, rpipe)
-        if not unique_build and unique_probe and self.how == "inner":
-            # inner join with unique LEFT side: swap roles — left becomes
-            # the build, output rows ride at right capacity
-            return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
-                                       rcomb, rvalid, hashed, prepped)
-        if not unique_build:
+        if self.how == "inner":
+            # strategy choice by OUTPUT CAPACITY: every op downstream of
+            # this join (further joins, aggregation, sort) runs at the
+            # capacity chosen here, so a selective join must shrink the
+            # pipeline even when a gather-style join is locally cheaper.
+            # (Profiled: q3's swapped join emitted at lineitem's 3.05M
+            # capacity and the group-by sort-aggregated 3M rows for a
+            # 32k-pair join — 1.2 s of gathers/sorts for a ~250 ms query.)
+            # Expansion pays an extra offsets-searchsorted + pair mask,
+            # so it must be ~2x smaller to win.
+            cands = []
+            if unique_build:
+                cands.append((lpipe.capacity, 0, "build"))
+            if unique_probe:
+                cands.append((rpipe.capacity, 1, "swap"))
+            if sized_cap is not None:
+                cands.append((sized_cap * 2, 2, "expand"))
+            strat = min(cands)[2] if cands else None
+            if strat == "swap":
+                return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
+                                           rcomb, rvalid, hashed, prepped)
+            if strat == "expand":
+                ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
+                                             lcomb, lpipe.mask & lvalid)
+                return self._pairs_pipe(lpipe, rpipe, ranges, hashed,
+                                        prepped, sized_cap)
+            # fall through: unique-build gather at probe capacity
+        elif not unique_build:
             ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
                                          lcomb, lpipe.mask & lvalid)
             if sized_cap is None:
