@@ -104,7 +104,8 @@ class PhysicalPlan:
 
         def collect(p: PhysicalPlan) -> None:
             if isinstance(p, BatchScanExec):
-                scans.append(p)
+                if not p.aux:  # derived data, not identity
+                    scans.append(p)
                 return
             for c in p.children():
                 collect(c)
@@ -159,6 +160,9 @@ class BatchScanExec(PhysicalPlan):
     stages). Analogue of LocalTableScanExec / columnar scan output."""
 
     batch: Batch
+    #: aux scans carry DERIVED device data (cached join indexes) fully
+    #: determined by the real leaves — excluded from stats_key identity
+    aux: bool = False
     traceable = True
 
     @property
@@ -354,6 +358,55 @@ class LimitExec(PhysicalPlan):
 
     def plan_key(self):
         return ("Limit", self.n, self.offset, self.child.plan_key())
+
+
+@dataclass(eq=False)
+class CompactExec(PhysicalPlan):
+    """Gather live rows to the front and truncate to a recorded bucketed
+    capacity — planned at the query root from output-size stats
+    (planner._OUTPUT_STATS) so the host fetch moves ``bucket(live)``
+    rows instead of the full pipeline capacity. On a tunneled TPU the
+    fetch is latency- and bandwidth-bound (~120 ms + ~11 MB/s measured),
+    so fetching a 10-row result at a 32k capacity dominated short
+    queries. AQE-style output coalescing (reference analogue:
+    CoalesceShufflePartitions.scala). Stable compaction preserves sorted
+    row order."""
+
+    child: PhysicalPlan
+    cap: int
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        if self.cap >= pipe.capacity:
+            return pipe
+        idx = K.compaction_permutation(pipe.mask)[: self.cap]
+        cols: Dict[str, TV] = {}
+        for name in pipe.order:
+            tv = pipe.cols[name]
+            cols[name] = TV(
+                tv.data[idx],
+                None if tv.validity is None else tv.validity[idx],
+                tv.dtype, tv.dictionary)
+        return Pipe(cols, pipe.mask[idx], pipe.order)
+
+    def node_string(self):
+        return f"Compact[{self.cap}]"
+
+    def plan_key(self):
+        # TRANSPARENT: adaptive stats recorded on a blocking run (where
+        # the executor compacts between stages invisibly) must still be
+        # found when the replayed plan carries explicit CompactExec
+        # nodes. The stage cache distinguishes compaction via
+        # planner._adaptive_snapshot instead.
+        return self.child.plan_key()
 
 
 @dataclass(eq=False)
@@ -928,6 +981,43 @@ class _AdaptiveStatsCache:
 
 _JOIN_STATS = _AdaptiveStatsCache()
 
+
+class _JoinIndexCache(_AdaptiveStatsCache):
+    """Join-index cache bounded by pinned DEVICE BYTES, not entry count:
+    a lineitem-scale index holds ~100 MB of HBM while a dimension-table
+    index is a few KB, so a count LRU either starves breadth or risks
+    HBM. Values are (orient, index_batch, tables_batch|None). Note an
+    evicted index is only re-recorded by a future BLOCKING run (new leaf
+    arrays); until then the join still executes correctly through the
+    live build_join_ranges path, just without the speedup."""
+
+    def __init__(self, max_bytes: int = 1 << 30):
+        super().__init__(maxsize=1 << 62)
+        self._max_bytes = max_bytes
+
+    @staticmethod
+    def _nbytes(value) -> int:
+        _, ib, tb = value
+        total = 0
+        for b in (ib, tb):
+            if b is None:
+                continue
+            for cd in b.data.columns:
+                total += cd.data.size * cd.data.dtype.itemsize
+        return total
+
+    def put(self, key_and_pins, value) -> None:
+        super().put(key_and_pins, value)
+        total = sum(self._nbytes(v) for v, _ in self._data.values())
+        while total > self._max_bytes and len(self._data) > 1:
+            _, (v, _) = self._data.popitem(last=False)
+            total -= self._nbytes(v)
+
+
+#: Cached join build indexes (kernels.make_join_index outputs, wrapped
+#: as aux Batches); leaf weakrefs evict entries when their data dies.
+_JOIN_INDEX = _JoinIndexCache()
+
 #: Adaptive aggregation statistics: observed group count per
 #: (plan, leaf-array-ids) — lets the sort-based aggregation path trace
 #: with a static output capacity on re-execution (same AQE idea as
@@ -952,6 +1042,16 @@ class JoinExec(PhysicalPlan):
     condition: Optional[E.Expression] = None
     #: bound by the planner from _JOIN_STATS: tuple of per-key (mn, rg)
     adaptive: Optional[tuple] = None
+    #: bound by the planner from _JOIN_INDEX: aux scans over the cached
+    #: build-side sort permutation / sorted key / dense lo+cnt tables
+    #: (kernels.make_join_index). Excluded from plan_key/stats_key —
+    #: they are derived data; the stage cache distinguishes their
+    #: presence via planner._adaptive_snapshot.
+    index_scan: Optional[PhysicalPlan] = None
+    table_scan: Optional[PhysicalPlan] = None
+    #: orientation the cached index was built for: 'fwd' = build on the
+    #: right (every path but swap), 'rev' = build on the left (swap)
+    index_orient: Optional[str] = None
 
     @property
     def traceable(self) -> bool:
@@ -977,7 +1077,63 @@ class JoinExec(PhysicalPlan):
                 and self.condition is None and self.adaptive[0] != "hash")
 
     def children(self):
-        return (self.left, self.right)
+        out = (self.left, self.right)
+        if self.index_scan is not None:
+            out += (self.index_scan,)
+        if self.table_scan is not None:
+            out += (self.table_scan,)
+        return out
+
+    def _strategy(self, unique_build: bool, unique_probe: bool,
+                  sized_cap, lcap: int, rcap: int):
+        """Traced-join strategy and the orientation its ranges need.
+        Chosen by OUTPUT capacity (see trace()); shared with the
+        blocking recorder so the cached index matches the orientation
+        the next trace will pick. Returns (strat, 'fwd'|'rev')."""
+        if self.how == "inner":
+            cands = []
+            if unique_build:
+                cands.append((lcap, 0, "build"))
+            if unique_probe:
+                cands.append((rcap, 1, "swap"))
+            if sized_cap is not None:
+                cands.append((sized_cap * 2, 2, "expand"))
+            if not cands:
+                return None, "fwd"
+            strat = min(cands)[2]
+            return strat, ("rev" if strat == "swap" else "fwd")
+        if unique_build:
+            return "build", "fwd"
+        if sized_cap is None:
+            return "member", "fwd"
+        return "expand", "fwd"
+
+    def _indexed_ranges(self, build_key, build_ok, probe_key, probe_ok,
+                        child_pipes: List[Pipe], want: str):
+        """Join ranges via the cached index when one with the right
+        orientation is bound; the live build_join_ranges otherwise."""
+        if self.index_scan is not None and len(child_pipes) > 2 \
+                and self.index_orient == want:
+            ipipe = child_pipes[2]
+            perm = ipipe.cols["perm"].data
+            skey = ipipe.cols["skey"].data
+            # layout guard: the index is positional, recorded against
+            # the build side as the blocking run saw it (possibly
+            # compacted). If the corresponding _COMPACT_STATS entry was
+            # independently evicted, the traced build pipe rides at a
+            # DIFFERENT capacity — replaying the index would gather
+            # arbitrary rows. A recorded compaction always changes the
+            # capacity, so shape equality is the invariant.
+            if perm.shape[0] == build_key.shape[0]:
+                lo_t = cnt_t = None
+                if self.table_scan is not None and len(child_pipes) > 3:
+                    tpipe = child_pipes[3]
+                    lo_t = tpipe.cols["lo"].data
+                    cnt_t = tpipe.cols["cnt"].data
+                return K.ranges_from_index(perm, skey, lo_t, cnt_t,
+                                           probe_key, probe_ok)
+        return K.build_join_ranges(build_key, build_ok,
+                                   probe_key, probe_ok)
 
     @property
     def schema(self) -> Schema:
@@ -1129,54 +1285,46 @@ class JoinExec(PhysicalPlan):
         one match (adaptive stats proved it), so output capacity equals
         probe capacity and no sizing sync is needed. This is the PK-FK
         fast path every TPC-H join takes after the first execution."""
-        lpipe, rpipe = child_pipes
+        lpipe, rpipe = child_pipes[:2]
         unique_build, unique_probe = self.adaptive[1], self.adaptive[2]
         sized_cap = self.adaptive[3] if len(self.adaptive) > 3 else None
         lcomb, lvalid, rcomb, rvalid, hashed, prepped = self._traced_keys(
             lpipe, rpipe)
-        if self.how == "inner":
-            # strategy choice by OUTPUT CAPACITY: every op downstream of
-            # this join (further joins, aggregation, sort) runs at the
-            # capacity chosen here, so a selective join must shrink the
-            # pipeline even when a gather-style join is locally cheaper.
-            # (Profiled: q3's swapped join emitted at lineitem's 3.05M
-            # capacity and the group-by sort-aggregated 3M rows for a
-            # 32k-pair join — 1.2 s of gathers/sorts for a ~250 ms query.)
-            # Expansion pays an extra offsets-searchsorted + pair mask,
-            # so it must be ~2x smaller to win.
-            cands = []
-            if unique_build:
-                cands.append((lpipe.capacity, 0, "build"))
-            if unique_probe:
-                cands.append((rpipe.capacity, 1, "swap"))
-            if sized_cap is not None:
-                cands.append((sized_cap * 2, 2, "expand"))
-            strat = min(cands)[2] if cands else None
-            if strat == "swap":
-                return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
-                                           rcomb, rvalid, hashed, prepped)
-            if strat == "expand":
-                ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
-                                             lcomb, lpipe.mask & lvalid)
-                return self._pairs_pipe(lpipe, rpipe, ranges, hashed,
-                                        prepped, sized_cap)
-            # fall through: unique-build gather at probe capacity
-        elif not unique_build:
-            ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
-                                         lcomb, lpipe.mask & lvalid)
-            if sized_cap is None:
-                # semi/anti without condition/hash: membership only, no
-                # expansion needed at any capacity
-                has = ranges.counts > 0
-                keep = lpipe.mask & (has if self.how == "left_semi"
-                                     else ~has)
-                return Pipe(lpipe.cols, keep, lpipe.order)
-            # neither side unique: general expansion at the capacity the
-            # first (blocking) run recorded for these exact leaves
+        # strategy choice by OUTPUT CAPACITY: every op downstream of
+        # this join (further joins, aggregation, sort) runs at the
+        # capacity chosen here, so a selective join must shrink the
+        # pipeline even when a gather-style join is locally cheaper.
+        # (Profiled: q3's swapped join emitted at lineitem's 3.05M
+        # capacity and the group-by sort-aggregated 3M rows for a
+        # 32k-pair join — 1.2 s of gathers/sorts for a ~250 ms query.)
+        # Expansion pays an extra offsets-searchsorted + pair mask,
+        # so it must be ~2x smaller to win.
+        strat, _ = self._strategy(unique_build, unique_probe, sized_cap,
+                                  lpipe.capacity, rpipe.capacity)
+        if strat == "swap":
+            return self._trace_swapped(lpipe, rpipe, lcomb, lvalid,
+                                       rcomb, rvalid, hashed, prepped,
+                                       child_pipes)
+        if strat == "expand":
+            ranges = self._indexed_ranges(rcomb, rpipe.mask & rvalid,
+                                          lcomb, lpipe.mask & lvalid,
+                                          child_pipes, "fwd")
             return self._pairs_pipe(lpipe, rpipe, ranges, hashed,
                                     prepped, sized_cap)
-        ranges = K.build_join_ranges(rcomb, rpipe.mask & rvalid,
-                                     lcomb, lpipe.mask & lvalid)
+        if strat == "member":
+            # semi/anti without condition/hash: membership only, no
+            # expansion needed at any capacity
+            ranges = self._indexed_ranges(rcomb, rpipe.mask & rvalid,
+                                          lcomb, lpipe.mask & lvalid,
+                                          child_pipes, "fwd")
+            has = ranges.counts > 0
+            keep = lpipe.mask & (has if self.how == "left_semi"
+                                 else ~has)
+            return Pipe(lpipe.cols, keep, lpipe.order)
+        # strat == 'build': unique-build gather at probe capacity
+        ranges = self._indexed_ranges(rcomb, rpipe.mask & rvalid,
+                                      lcomb, lpipe.mask & lvalid,
+                                      child_pipes, "fwd")
         has = ranges.counts > 0
         b_idx = ranges.build_perm[
             jnp.clip(ranges.lo, 0, rpipe.capacity - 1)]
@@ -1220,11 +1368,13 @@ class JoinExec(PhysicalPlan):
         return Pipe(cols, lpipe.mask, order)
 
     def _trace_swapped(self, lpipe: Pipe, rpipe: Pipe, lcomb, lvalid,
-                       rcomb, rvalid, hashed=False, prepped=()) -> Pipe:
+                       rcomb, rvalid, hashed=False, prepped=(),
+                       child_pipes=()) -> Pipe:
         """Inner join with a unique LEFT side: build on the left, stream
         the right; each right row gathers its single left match."""
-        ranges = K.build_join_ranges(lcomb, lpipe.mask & lvalid,
-                                     rcomb, rpipe.mask & rvalid)
+        ranges = self._indexed_ranges(lcomb, lpipe.mask & lvalid,
+                                      rcomb, rpipe.mask & rvalid,
+                                      list(child_pipes), "rev")
         has = ranges.counts > 0
         l_idx = ranges.build_perm[
             jnp.clip(ranges.lo, 0, lpipe.capacity - 1)]
@@ -1253,6 +1403,50 @@ class JoinExec(PhysicalPlan):
             ctv = C.evaluate(self.condition, env)
             pair_ok = pair_ok & ctv.data & ctv.valid_or_true(rpipe.capacity)
         return Pipe(cols, pair_ok, order)
+
+    def _record_index(self, sk, orient: str, build_key, build_ok,
+                      packing) -> None:
+        """Build and cache the reusable join index (perm + sorted key
+        [+ dense lo/cnt tables]) for these leaves. One-time device work
+        on the blocking run; later traces consume it as jit arguments
+        via aux BatchScanExec children.
+
+        The index is POSITIONAL, so the build side's row layout must be
+        identical between the blocking run that recorded it and the
+        traced run that replays it. Joins and adaptive aggregations emit
+        different layouts on their blocking vs traced paths (expansion
+        order vs gather order), so a build subtree containing one is
+        skipped — the trace falls back to live build_join_ranges."""
+        build_side = self.left if orient == "rev" else self.right
+
+        def layout_stable(p: PhysicalPlan) -> bool:
+            if isinstance(p, (JoinExec, HashAggregateExec)):
+                return False
+            return all(layout_stable(c) for c in p.children())
+
+        if not layout_stable(build_side):
+            return
+        domain = None
+        if packing != "hash":
+            domain = 1
+            for _, rg in packing:
+                domain *= rg
+        perm, skey, lo_t, cnt_t = K.make_join_index(
+            build_key, build_ok, domain)
+
+        def aux_batch(named):
+            fields = tuple(
+                Field(name, T.INT32 if a.dtype == jnp.int32 else T.INT64,
+                      nullable=False)
+                for name, a in named)
+            cols = tuple(ColumnData(a, None) for _, a in named)
+            mask = jnp.ones((named[0][1].shape[0],), dtype=jnp.bool_)
+            return Batch(Schema(fields), BatchData(cols, mask))
+
+        ib = aux_batch((("perm", perm), ("skey", skey)))
+        tb = (aux_batch((("lo", lo_t), ("cnt", cnt_t)))
+              if lo_t is not None else None)
+        _JOIN_INDEX.put(sk, (orient, ib, tb))
 
     def execute_blocking(self, child_batches: List[Batch]) -> Batch:
         lpipe = Pipe.from_batch_data(child_batches[0].schema,
@@ -1287,6 +1481,8 @@ class JoinExec(PhysicalPlan):
             if record:
                 maxc = int(jax.device_get(ranges.counts.max()))
                 _JOIN_STATS.put(sk, (packing, maxc <= 1, False, None))
+                self._record_index(sk, "fwd", rkey,
+                                   rpipe.mask & rvalid, packing)
             has_match = ranges.counts > 0
             keep = lpipe.mask & (has_match if how == "left_semi"
                                  else ~has_match)
@@ -1310,6 +1506,16 @@ class JoinExec(PhysicalPlan):
             # negative uniqueness results cached too; the capacity makes
             # the sized-expansion trace available regardless
             _JOIN_STATS.put(sk, (packing, maxc <= 1, maxb <= 1, cap))
+            # cache the build index for the orientation the NEXT traced
+            # execution will pick, so it skips the argsort + searchsorted
+            _, orient = self._strategy(maxc <= 1, maxb <= 1, cap,
+                                       lpipe.capacity, rpipe.capacity)
+            if orient == "rev":
+                self._record_index(sk, "rev", lkey,
+                                   lpipe.mask & lvalid, packing)
+            else:
+                self._record_index(sk, "fwd", rkey,
+                                   rpipe.mask & rvalid, packing)
         else:
             st = _JOIN_STATS.get(sk) if sk is not None else None
             if st is not None and len(st) > 3 and st[3] is not None:

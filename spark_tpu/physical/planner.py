@@ -128,7 +128,18 @@ def _bind_adaptive(plan: P.PhysicalPlan) -> None:
         _bind_adaptive(c)
     if isinstance(plan, P.JoinExec) and plan.how in (
             "inner", "left", "left_semi", "left_anti") and plan.left_keys:
-        plan.adaptive = P._JOIN_STATS.get(plan.stats_key())
+        sk = plan.stats_key()
+        plan.adaptive = P._JOIN_STATS.get(sk)
+        plan.index_scan = plan.table_scan = None
+        plan.index_orient = None
+        if plan.adaptive is not None:
+            idx = P._JOIN_INDEX.get(sk)
+            if idx is not None:
+                orient, ib, tb = idx
+                plan.index_scan = P.BatchScanExec(ib, aux=True)
+                plan.table_scan = (P.BatchScanExec(tb, aux=True)
+                                   if tb is not None else None)
+                plan.index_orient = orient
     elif isinstance(plan, P.HashAggregateExec) and plan.groupings \
             and not plan._static_direct_ok():
         plan.adaptive = P._AGG_STATS.get(plan.stats_key())
@@ -140,8 +151,20 @@ def _adaptive_snapshot(plan: P.PhysicalPlan) -> tuple:
     out = []
 
     def go(p: P.PhysicalPlan) -> None:
-        if isinstance(p, (P.JoinExec, P.HashAggregateExec)):
+        if isinstance(p, P.JoinExec):
+            # index presence/shape changes the traced program but is
+            # deliberately excluded from plan_key (stats identity)
+            out.append((p.adaptive, p.index_orient,
+                        None if p.index_scan is None
+                        else p.index_scan.plan_key(),
+                        None if p.table_scan is None
+                        else p.table_scan.plan_key()))
+        elif isinstance(p, P.HashAggregateExec):
             out.append(p.adaptive)
+        elif isinstance(p, P.CompactExec):
+            # plan_key is transparent for stats stability; the snapshot
+            # carries the compaction so stage programs don't collide
+            out.append(("compact", p.cap))
         for c in p.children():
             go(c)
 
@@ -186,32 +209,86 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
     return Batch(schema_box["schema"], data)
 
 
-def _maybe_compact(batch: Batch) -> Batch:
+#: Observed inter-stage compaction capacities per (plan, leaf-ids):
+#: 0 = "compaction not worthwhile here". Replayed as explicit
+#: CompactExec nodes (see _replay_compactions) so fully-traced
+#: re-executions see EXACTLY the same arrays the blocking run fed to
+#: downstream operators — required for _JOIN_INDEX position validity,
+#: and it keeps the traced pipeline at the shrunken capacity (AQE
+#: coalescing, reference: CoalesceShufflePartitions.scala).
+_COMPACT_STATS = P._AdaptiveStatsCache()
+
+
+def _compact_to(batch: Batch, new_cap: int) -> Batch:
+    """Route through CompactExec so the blocking-run compaction and the
+    traced replay are structurally the SAME code — _JOIN_INDEX position
+    validity depends on them producing bit-identical layouts."""
+    node = P.CompactExec(P.BatchScanExec(batch), new_cap)
+    return node.execute_blocking([batch])
+
+
+def _maybe_compact(batch: Batch, child: P.PhysicalPlan) -> Batch:
     """Shrink sparse batches between stages so capacities don't cascade
     (the reference's equivalent pressure valve is AQE partition
-    coalescing, CoalesceShufflePartitions.scala)."""
+    coalescing, CoalesceShufflePartitions.scala). The decision is
+    recorded per (plan, leaves) and replayed inside later traced
+    executions — see _COMPACT_STATS."""
     cap = batch.capacity
-    if cap <= 4096:
+    if cap <= 4096 or isinstance(child, P.BatchScanExec):
         return batch
-    live = int(np.asarray(batch.data.row_mask).sum())
-    if live * 4 > cap:
+    sk = child.stats_key()
+    new_cap = _COMPACT_STATS.get(sk)
+    if new_cap is None:
+        live = int(np.asarray(batch.data.row_mask).sum())  # host sync
+        new_cap = K.bucket(live) if live * 4 <= cap else 0
+        _COMPACT_STATS.put(sk, new_cap)
+    if not new_cap or new_cap >= cap:
         return batch
-    new_cap = K.bucket(live)
-    perm = K.compaction_permutation(batch.data.row_mask)
-    idx = perm[:new_cap]
-    from spark_tpu.columnar.batch import BatchData, ColumnData
+    return _compact_to(batch, new_cap)
 
-    cols = tuple(
-        ColumnData(cd.data[idx],
-                   None if cd.validity is None else cd.validity[idx])
-        for cd in batch.data.columns)
-    return Batch(batch.schema, BatchData(cols, batch.data.row_mask[idx]))
+
+def _replay_compactions(plan: P.PhysicalPlan) -> P.PhysicalPlan:
+    """Insert explicit CompactExec nodes where blocking runs compacted,
+    so fused traces reproduce the identical intermediate arrays."""
+    if isinstance(plan, P.BatchScanExec):
+        return plan
+    fields = {}
+    changed = False
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, P.PhysicalPlan) and not isinstance(
+                v, P.BatchScanExec):
+            nv = _replay_compactions(v)
+            cap = _COMPACT_STATS.get(nv.stats_key())
+            if cap:
+                nv = P.CompactExec(nv, cap)
+            if nv is not v:
+                changed = True
+            fields[f.name] = nv
+        else:
+            fields[f.name] = v
+    return dataclasses.replace(plan, **fields) if changed else plan
+
+
+#: Observed live output rows per (plan, leaf-array-ids): re-executions
+#: compact the result to bucket(live) ON DEVICE before the host fetch
+#: (see P.CompactExec). Sound for the same reason join/agg stats replay
+#: is: same immutable leaves + same plan => same live count.
+_OUTPUT_STATS = P._AdaptiveStatsCache()
 
 
 def execute(plan: P.PhysicalPlan) -> Batch:
     """Run a physical plan: fuse what we can, block where we must."""
+    plan = _replay_compactions(plan)
     _bind_adaptive(plan)
-    return _execute(plan)
+    sk = plan.stats_key()
+    cap = _OUTPUT_STATS.get(sk)
+    if cap is not None:
+        return _execute(P.CompactExec(plan, cap))
+    batch = _execute(plan)
+    live = int(np.asarray(batch.data.row_mask).sum())  # first run only
+    _OUTPUT_STATS.put(sk, K.bucket(live))
+    return batch
 
 
 def _execute(plan: P.PhysicalPlan) -> Batch:
@@ -225,7 +302,7 @@ def _execute(plan: P.PhysicalPlan) -> Batch:
     child_batches = []
     for c in plan.children():
         b = _execute(c)
-        child_batches.append(_maybe_compact(b))
+        child_batches.append(_maybe_compact(b, c))
     with metrics.stage_timer("blocking", node=plan.node_string(),
                              cap_in=[b.capacity for b in child_batches]):
         return plan.execute_blocking(child_batches)
