@@ -41,6 +41,14 @@ def arrow_type_to_dtype(at: pa.DataType) -> T.DataType:
     if pa.types.is_float64(at):
         return T.FLOAT64
     if pa.types.is_decimal(at):
+        if at.precision > T.DecimalType.MAX_PRECISION:
+            # the device representation is scaled int64 (18 digits); a
+            # wider column's high limb carries real data the ingest
+            # would silently drop — refuse loudly instead
+            raise NotImplementedError(
+                f"decimal({at.precision},{at.scale}) exceeds the "
+                f"engine's {T.DecimalType.MAX_PRECISION}-digit "
+                f"(int64) cap")
         return T.DecimalType(at.precision, at.scale)
     if pa.types.is_string(at) or pa.types.is_large_string(at):
         return T.STRING
@@ -69,7 +77,7 @@ def dtype_to_arrow_type(dt: T.DataType) -> pa.DataType:
     if isinstance(dt, T.Float64Type):
         return pa.float64()
     if isinstance(dt, T.DecimalType):
-        return pa.float64()
+        return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, T.StringType):
         return pa.string()
     if isinstance(dt, T.DateType):
@@ -77,6 +85,26 @@ def dtype_to_arrow_type(dt: T.DataType) -> pa.DataType:
     if isinstance(dt, T.TimestampType):
         return pa.timestamp("us")
     raise TypeError(f"unsupported dtype: {dt}")
+
+
+def decimal_from_unscaled(unscaled: np.ndarray,
+                          typ: pa.DataType,
+                          validity: Optional[np.ndarray] = None) -> pa.Array:
+    """Exact decimal128 column from unscaled int64 values via the raw
+    16-byte little-endian buffer — a per-value python-Decimal loop is
+    ~100x slower at lineitem scale. Values must fit int64 (the engine's
+    p<=18 cap guarantees it)."""
+    unscaled = unscaled.astype(np.int64)
+    buf = np.empty((len(unscaled), 2), dtype=np.int64)
+    buf[:, 0] = unscaled
+    buf[:, 1] = np.where(unscaled < 0, -1, 0)  # sign extension limb
+    vbuf = None
+    if validity is not None and not validity.all():
+        vbuf = pa.py_buffer(np.packbits(
+            validity.astype(np.uint8), bitorder="little").tobytes())
+    return pa.Array.from_buffers(
+        typ, len(unscaled), [vbuf, pa.py_buffer(buf.tobytes())],
+        null_count=-1 if vbuf is not None else 0)
 
 
 def _column_to_numpy(
@@ -101,14 +129,16 @@ def _column_to_numpy(
                     for s in arr.dictionary.to_pylist()]
         codes = pc.fill_null(arr.indices, 0).to_numpy(zero_copy_only=False)
         values = np.ascontiguousarray(codes, dtype=np.int32)
-        # Normalize to a SORTED dictionary so code order == lexicographic
-        # order: string min/max/compare/sort become plain int32 ops on
-        # device (no rank tables needed).
-        order = sorted(range(len(raw_dict)), key=lambda i: raw_dict[i])
-        remap = np.empty(len(raw_dict), dtype=np.int32)
-        for new_code, old_code in enumerate(order):
-            remap[old_code] = new_code
-        dictionary = tuple(raw_dict[i] for i in order)
+        # Normalize to a SORTED, DEDUPLICATED dictionary so code order ==
+        # lexicographic order AND code equality == value equality (the
+        # engine's GROUP BY/DISTINCT/join invariant): string min/max/
+        # compare/sort become plain int32 ops on device. Pre-encoded
+        # inputs (dictionary parquet) may legally carry duplicate values
+        # — equal strings must collapse to ONE code.
+        uniq = sorted(set(raw_dict))
+        pos = {s: i for i, s in enumerate(uniq)}
+        remap = np.array([pos[s] for s in raw_dict], dtype=np.int32)
+        dictionary = tuple(uniq)
         if len(remap):
             values = remap[values]
         if validity is not None:
@@ -116,7 +146,27 @@ def _column_to_numpy(
         return values.astype(np.int32, copy=False), validity, dictionary
 
     if isinstance(dtype, T.DecimalType):
-        arr = arr.cast(pa.float64())
+        if pa.types.is_decimal(arr.type):
+            # exact unscaled int64 straight from the decimal128 buffer:
+            # low limb of each 16-byte little-endian value (values fit
+            # int64 at the engine's p<=18 cap, so the high limb is pure
+            # sign extension)
+            assert arr.type.scale == dtype.scale
+            raw = np.frombuffer(arr.buffers()[1], dtype=np.int64)
+            lo = arr.offset * 2
+            values = raw[lo:lo + 2 * len(arr):2].copy()
+            if validity is not None:
+                values = np.where(validity, values, 0)
+            return values, validity, None
+        # non-decimal storage (e.g. float parquet read with a decimal
+        # schema): scale + round through float64, HALF_UP like every
+        # other float->decimal path (np.rint would be HALF_EVEN)
+        f = np.nan_to_num(
+            arr.cast(pa.float64()).to_numpy(zero_copy_only=False))
+        scaled = f * (10 ** dtype.scale)
+        values = (np.sign(scaled)
+                  * np.floor(np.abs(scaled) + 0.5)).astype(np.int64)
+        return values, validity, None
     if isinstance(dtype, T.DateType):
         arr = arr.cast(pa.int32())
     if isinstance(dtype, T.TimestampType):
@@ -180,6 +230,10 @@ def to_arrow(batch: Batch) -> pa.Table:
             arr = pa.array(data, type=pa.int64(),
                            mask=None if valid is None else ~valid).cast(
                 pa.timestamp("us"))
+        elif isinstance(f.dtype, T.DecimalType):
+            arr = decimal_from_unscaled(
+                data, pa.decimal128(f.dtype.precision, f.dtype.scale),
+                valid)
         else:
             arr = pa.array(data, type=dtype_to_arrow_type(f.dtype),
                            mask=None if valid is None else ~valid)

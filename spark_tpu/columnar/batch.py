@@ -148,8 +148,11 @@ class Batch:
             ih = np.asarray(iplane)
             fh = fut.result()
         else:
+            # all-integer batch (e.g. decimal money results): do NOT
+            # fetch the empty float plane — even a zero-size device_get
+            # pays the full tunnel round trip
             ih = np.asarray(iplane)
-            fh = np.asarray(fplane)
+            fh = np.zeros((0, 0), dtype=np.float64)
 
         def restore(plane, slot, dt):
             row = ih[slot] if plane == "i" else fh[slot]
@@ -175,7 +178,8 @@ class Batch:
         dictionaries and dates). For tests and `.collect()`."""
         import datetime
 
-        from spark_tpu.types import DateType, StringType, TimestampType
+        from spark_tpu.types import (DateType, DecimalType, StringType,
+                                     TimestampType)
 
         mask, host_cols = self.fetch_host()
         out_rows: list = []
@@ -203,6 +207,14 @@ class Batch:
                 epoch = datetime.datetime(1970, 1, 1)
                 vals = [
                     epoch + datetime.timedelta(microseconds=int(d)) if v else None
+                    for d, v in zip(data, valid)
+                ]
+            elif isinstance(f.dtype, DecimalType):
+                import decimal as _decimal
+
+                s = f.dtype.scale
+                vals = [
+                    _decimal.Decimal(int(d)).scaleb(-s) if v else None
                     for d, v in zip(data, valid)
                 ]
             else:

@@ -71,7 +71,42 @@ def _jnp_dtype(dt: DataType):
     return jnp.dtype(dt.np_dtype)
 
 
+def _round_half_up_div(data: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """Exact scaled-int scale-down with HALF_UP rounding (the
+    reference's Decimal.changePrecision ROUND_HALF_UP default)."""
+    half = factor // 2
+    mag = (jnp.abs(data) + half) // factor
+    return jnp.sign(data) * mag
+
+
+def _float_to_scaled(data: jnp.ndarray, scale: int) -> jnp.ndarray:
+    """float -> scaled int64 with HALF_UP rounding (jnp.round would be
+    banker's HALF_EVEN, which diverges from the reference on .5s)."""
+    scaled = data.astype(jnp.float64) * float(10 ** scale)
+    return (jnp.sign(scaled)
+            * jnp.floor(jnp.abs(scaled) + 0.5)).astype(jnp.int64)
+
+
 def _cast_data(data: jnp.ndarray, src: DataType, dst: DataType) -> jnp.ndarray:
+    sdec = isinstance(src, T.DecimalType)
+    ddec = isinstance(dst, T.DecimalType)
+    if sdec and ddec:
+        if src.scale == dst.scale:
+            return data
+        if dst.scale > src.scale:
+            return data * (10 ** (dst.scale - src.scale))
+        return _round_half_up_div(data, 10 ** (src.scale - dst.scale))
+    if sdec:
+        if isinstance(dst, (T.Float32Type, T.Float64Type)):
+            return (data.astype(jnp.float64)
+                    / float(10 ** src.scale)).astype(_jnp_dtype(dst))
+        # decimal -> integral truncates toward zero (Decimal.toLong)
+        mag = jnp.abs(data) // (10 ** src.scale)
+        return (jnp.sign(data) * mag).astype(_jnp_dtype(dst))
+    if ddec:
+        if src.is_integral or isinstance(src, T.BooleanType):
+            return data.astype(jnp.int64) * (10 ** dst.scale)
+        return _float_to_scaled(data, dst.scale)
     if type(src) is type(dst):
         return data
     return data.astype(_jnp_dtype(dst))
@@ -144,6 +179,11 @@ def _literal_tv(value, dtype: DataType, n: int) -> TV:
         value = T.date_to_days(value) if isinstance(value, datetime.date) else value
     if isinstance(dtype, T.TimestampType) and isinstance(value, datetime.datetime):
         value = int(value.timestamp() * 1_000_000)
+    if isinstance(dtype, T.DecimalType):
+        import decimal as _dec
+
+        q = _dec.Decimal(str(value)).scaleb(dtype.scale)
+        value = int(q.to_integral_value(rounding=_dec.ROUND_HALF_UP))
     data = jnp.full((n,), value, dtype=_jnp_dtype(dtype))
     return TV(data, None, dtype, None)
 
@@ -649,6 +689,12 @@ def _eval_arith(expr: E.Arith, env: Env) -> TV:
     if isinstance(lt.dtype, T.DateType) and isinstance(rt.dtype, T.DateType):
         return TV((lt.data - rt.data).astype(jnp.int32), valid, T.INT32, None)
 
+    if (isinstance(lt.dtype, T.DecimalType)
+            or isinstance(rt.dtype, T.DecimalType)):
+        dec_dt = expr._decimal_result(lt.dtype, rt.dtype)
+        if dec_dt is not None:
+            return _decimal_arith(expr.op, lt, rt, dec_dt, valid)
+
     out_dt = T.common_type(lt.dtype, rt.dtype)
     if expr.op == "/" and out_dt.is_integral:
         out_dt = T.FLOAT64
@@ -676,6 +722,53 @@ def _eval_arith(expr: E.Arith, env: Env) -> TV:
     else:
         raise NotImplementedError(expr.op)
     return TV(data, valid, out_dt, None)
+
+
+def _decimal_arith(op: str, lt: TV, rt: TV, out_dt, valid) -> TV:
+    """Exact scaled-int64 decimal arithmetic (reference:
+    decimalExpressions.scala over Decimal.scala). +,-,% align scales and
+    stay integral; * adds scales then rescales to the bounded result
+    type; / routes through float64 and rounds HALF_UP to the result
+    scale (exact for quotients below 2^53). Overflow past 18 digits is
+    not detected (the reference's int128 range is wider — documented
+    DecimalType deviation)."""
+    def as_scaled(tv, scale):
+        if isinstance(tv.dtype, T.DecimalType):
+            return _cast_data(tv.data, tv.dtype,
+                              T.DecimalType(T.DecimalType.MAX_PRECISION,
+                                            scale))
+        return tv.data.astype(jnp.int64) * (10 ** scale)
+
+    s1 = lt.dtype.scale if isinstance(lt.dtype, T.DecimalType) else 0
+    s2 = rt.dtype.scale if isinstance(rt.dtype, T.DecimalType) else 0
+    if op in ("+", "-"):
+        s = max(s1, s2)
+        ld, rd = as_scaled(lt, s), as_scaled(rt, s)
+        data = ld + rd if op == "+" else ld - rd
+        data = _cast_data(data, T.DecimalType(38, s), out_dt)
+        return TV(data, valid, out_dt, None)
+    if op == "*":
+        prod = as_scaled(lt, s1) * as_scaled(rt, s2)  # scale s1+s2
+        data = _cast_data(prod, T.DecimalType(38, s1 + s2), out_dt)
+        return TV(data, valid, out_dt, None)
+    if op == "/":
+        lf = as_scaled(lt, s1).astype(jnp.float64) / float(10 ** s1)
+        rf = as_scaled(rt, s2).astype(jnp.float64) / float(10 ** s2)
+        zero = rf == 0.0
+        safe = jnp.where(zero, jnp.ones_like(rf), rf)
+        data = _float_to_scaled(lf / safe, out_dt.scale)
+        return TV(data, _and_validity(valid, ~zero), out_dt, None)
+    if op == "%":
+        s = max(s1, s2)
+        ld, rd = as_scaled(lt, s), as_scaled(rt, s)
+        zero = rd == 0
+        safe = jnp.where(zero, jnp.ones_like(rd), rd)
+        # remainder keeps the dividend's sign
+        mag = jnp.abs(ld) - (jnp.abs(ld) // jnp.abs(safe)) * jnp.abs(safe)
+        data = jnp.sign(ld) * mag
+        data = _cast_data(data, T.DecimalType(38, s), out_dt)
+        return TV(data, _and_validity(valid, ~zero), out_dt, None)
+    raise NotImplementedError(op)
 
 
 def _string_cmp_tables(lt: TV, rt: TV, op: str, n: int):
@@ -733,6 +826,12 @@ def _eval_cast(expr: E.Cast, env: Env) -> TV:
     n = env.capacity
     tv = evaluate(expr.child, env)
     dst = expr.dtype
+    if isinstance(tv.dtype, T.DecimalType) and isinstance(
+            dst, T.DecimalType):
+        if tv.dtype.scale == dst.scale:
+            return TV(tv.data, tv.validity, dst, None)
+        return TV(_cast_data(tv.data, tv.dtype, dst), tv.validity, dst,
+                  None)
     if type(tv.dtype) is type(dst):
         return tv
     if isinstance(dst, T.StringType):
@@ -743,6 +842,13 @@ def _eval_cast(expr: E.Cast, env: Env) -> TV:
             table = np.array(
                 [T.date_to_days(datetime.date.fromisoformat(s))
                  for s in (tv.dictionary or ())], dtype=np.int32)
+        elif isinstance(dst, T.DecimalType):
+            import decimal as _dec
+
+            table = np.array(
+                [int(_dec.Decimal(s).scaleb(dst.scale).to_integral_value(
+                    rounding=_dec.ROUND_HALF_UP))
+                 for s in (tv.dictionary or ())], dtype=np.int64)
         else:
             table = np.array([float(s) for s in (tv.dictionary or ())],
                              dtype=dst.np_dtype)

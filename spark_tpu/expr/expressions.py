@@ -283,10 +283,39 @@ class Arith(Expression):
             return T.DATE
         if isinstance(lt, T.DateType) and isinstance(rt, T.DateType) and self.op == "-":
             return T.INT32
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            dec = self._decimal_result(lt, rt)
+            if dec is not None:
+                return dec
         out = T.common_type(lt, rt)
         if self.op == "/" and out.is_integral:
             return T.FLOAT64  # SQL: integer / -> double (non-ANSI Spark)
         return out
+
+    def _decimal_result(self, lt, rt):
+        """Spark's decimal arithmetic result types (reference:
+        DecimalPrecision.scala / decimalExpressions.scala), bounded at
+        the engine's 18-digit cap. None -> fall through (decimal op
+        float = double)."""
+        if isinstance(lt, (T.Float32Type, T.Float64Type)) \
+                or isinstance(rt, (T.Float32Type, T.Float64Type)):
+            return None
+        p1 = lt.precision if isinstance(lt, T.DecimalType) else 19
+        s1 = lt.scale if isinstance(lt, T.DecimalType) else 0
+        p2 = rt.precision if isinstance(rt, T.DecimalType) else 19
+        s2 = rt.scale if isinstance(rt, T.DecimalType) else 0
+        if self.op in ("+", "-"):
+            s = max(s1, s2)
+            return T.bounded_decimal(max(p1 - s1, p2 - s2) + s + 1, s)
+        if self.op == "*":
+            return T.bounded_decimal(p1 + p2 + 1, s1 + s2)
+        if self.op == "/":
+            s = max(6, s1 + p2 + 1)
+            return T.bounded_decimal(p1 - s1 + s2 + s, s)
+        if self.op == "%":
+            return T.bounded_decimal(min(p1 - s1, p2 - s2) + max(s1, s2),
+                                     max(s1, s2))
+        return None
 
     def __str__(self):
         return f"({self.left} {self.op} {self.right})"
@@ -1104,6 +1133,9 @@ class Sum(AggregateExpression):
         dt = self.child.data_type(schema)
         if dt.is_integral:
             return T.INT64
+        if isinstance(dt, T.DecimalType):
+            # reference: Sum widens by 10 integral digits (Sum.scala)
+            return T.bounded_decimal(dt.precision + 10, dt.scale)
         return dt
 
     @property
@@ -1124,6 +1156,10 @@ class Avg(AggregateExpression):
         return (self.child,)
 
     def data_type(self, schema):
+        dt = self.child.data_type(schema)
+        if isinstance(dt, T.DecimalType):
+            # reference: Average adds 4 fractional digits (Average.scala)
+            return T.bounded_decimal(dt.precision + 4, dt.scale + 4)
         return T.FLOAT64
 
     @property

@@ -60,39 +60,61 @@ def _literal_value(e: E.Expression):
     raise _Untranslatable
 
 
-def _translate(e: E.Expression) -> "pads.Expression":
+def _coerce_literal(v, col_name: str, dtypes):
+    """Adapt a python literal to the column's storage type for pyarrow:
+    a float literal against a DECIMAL column must become a Decimal
+    scalar (arrow refuses decimal-vs-double comparisons: 'Precision is
+    not great enough'). str(float) round-trips the short literals SQL
+    texts contain, so 0.05 means exactly 0.05."""
+    if dtypes is None or not isinstance(v, (int, float)):
+        return v
+    dt = dtypes.get(col_name)
+    if isinstance(dt, T.DecimalType):
+        import decimal
+
+        return decimal.Decimal(str(v))
+    return v
+
+
+def _translate(e: E.Expression, dtypes=None) -> "pads.Expression":
     """Our Expression -> pyarrow.dataset Expression; raises
-    _Untranslatable for anything the scan layer cannot evaluate."""
+    _Untranslatable for anything the scan layer cannot evaluate.
+    ``dtypes`` ({col: DataType}, optional) enables storage-aware literal
+    coercion at actual read time."""
     import pyarrow.compute as pc
 
     if isinstance(e, E.Cmp):
         if isinstance(e.left, E.Col):
-            f, v, op = pc.field(e.left.col_name), _literal_value(e.right), e.op
+            name, v, op = e.left.col_name, _literal_value(e.right), e.op
         elif isinstance(e.right, E.Col):
             flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-            f, v = pc.field(e.right.col_name), _literal_value(e.left)
+            name, v = e.right.col_name, _literal_value(e.left)
             op = flip.get(e.op, e.op)
         else:
             raise _Untranslatable
         if v is None:
             raise _Untranslatable
+        f = pc.field(name)
+        v = _coerce_literal(v, name, dtypes)
         return {"==": f == v, "!=": f != v, "<": f < v,
                 "<=": f <= v, ">": f > v, ">=": f >= v}[op]
     if isinstance(e, E.In) and isinstance(e.child, E.Col):
         if any(v is None for v in e.values):
             raise _Untranslatable
-        return pc.field(e.child.col_name).isin(list(e.values))
+        vals = [_coerce_literal(v, e.child.col_name, dtypes)
+                for v in e.values]
+        return pc.field(e.child.col_name).isin(vals)
     if isinstance(e, E.IsNull) and isinstance(e.child, E.Col):
         return pc.field(e.child.col_name).is_null()
     if isinstance(e, E.Not):
         inner = e.child
         if isinstance(inner, E.IsNull) and isinstance(inner.child, E.Col):
             return ~pc.field(inner.child.col_name).is_null()
-        return ~_translate(inner)
+        return ~_translate(inner, dtypes)
     if isinstance(e, E.And):
-        return _translate(e.left) & _translate(e.right)
+        return _translate(e.left, dtypes) & _translate(e.right, dtypes)
     if isinstance(e, E.Or):
-        return _translate(e.left) | _translate(e.right)
+        return _translate(e.left, dtypes) | _translate(e.right, dtypes)
     raise _Untranslatable
 
 
@@ -113,13 +135,14 @@ def translate_filters(
 
 
 def _filters_to_pads(
-    filters: Tuple[E.Expression, ...]
+    filters: Tuple[E.Expression, ...],
+    dtypes=None,
 ) -> Optional["pads.Expression"]:
     if not filters:
         return None
-    out = _translate(filters[0])
+    out = _translate(filters[0], dtypes)
     for c in filters[1:]:
-        out = out & _translate(c)
+        out = out & _translate(c, dtypes)
     return out
 
 
@@ -240,6 +263,11 @@ class FileSource:
             self._schema = _schema_from_pa(self._open().schema)
         return self._schema
 
+    def _dtypes(self) -> Dict[str, Any]:
+        """{column: engine DataType} for storage-aware literal coercion
+        in pushed filters (decimal columns vs float literals)."""
+        return {f.name: f.dtype for f in self.schema.fields}
+
     # -- scanning ------------------------------------------------------------
 
     def read(self, columns: Optional[Tuple[str, ...]] = None,
@@ -256,7 +284,7 @@ class FileSource:
             return hit
         table = ds.to_table(
             columns=list(columns) if columns is not None else None,
-            filter=_filters_to_pads(filters))
+            filter=_filters_to_pads(filters, self._dtypes()))
         batch = from_arrow(table)
         # bounded LRU: parameterized pushed filters must not pin an
         # unbounded number of device-resident batches
@@ -273,7 +301,8 @@ class FileSource:
         key = tuple(E.expr_key(f) for f in filters)
         hit = self._count_cache.get(key)
         if hit is None:
-            hit = ds.count_rows(filter=_filters_to_pads(filters))
+            hit = ds.count_rows(
+                filter=_filters_to_pads(filters, self._dtypes()))
             self._count_cache[key] = hit
         return hit
 
@@ -292,7 +321,7 @@ class FileSource:
         n = 0
         for rb in ds.to_batches(
                 columns=list(columns) if columns is not None else None,
-                filter=_filters_to_pads(filters),
+                filter=_filters_to_pads(filters, self._dtypes()),
                 batch_size=rows_per_chunk):
             if rb.num_rows == 0:
                 continue

@@ -338,11 +338,18 @@ def _merged_agg(agg: E.AggregateExpression, env: Env, seg, mask,
     if isinstance(agg, E.Count):
         return TV(cnt, None, T.INT64, None)
     if isinstance(agg, E.Sum):
+        if isinstance(tv.dtype, T.DecimalType):
+            s = X.psum(K.seg_sum(tv.data, seg, ok, num_segments))
+            return TV(s, any_valid, P.decimal_sum_type(tv.dtype), None)
         out_dt = T.INT64 if tv.dtype.is_integral else tv.dtype
         data = tv.data.astype(C._jnp_dtype(out_dt))
         s = X.psum(K.seg_sum(data, seg, ok, num_segments))
         return TV(s, any_valid, out_dt, None)
     if isinstance(agg, E.Avg):
+        if isinstance(tv.dtype, T.DecimalType):
+            total = X.psum(K.seg_sum(tv.data, seg, ok, num_segments))
+            data, out_dt = P.decimal_avg(total, cnt, tv.dtype)
+            return TV(data, any_valid, out_dt, None)
         s = X.psum(K.seg_sum(tv.data.astype(jnp.float64), seg, ok,
                              num_segments))
         return TV(s / jnp.maximum(cnt, 1), any_valid, T.FLOAT64, None)

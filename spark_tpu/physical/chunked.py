@@ -27,10 +27,14 @@ from spark_tpu.plan import logical as L
 from spark_tpu.plan.incremental import AggSpec
 
 MAX_DEVICE_BATCH_BYTES = CF.register(
-    "spark.tpu.maxDeviceBatchBytes", 2 << 30,
+    "spark.tpu.maxDeviceBatchBytes", 5 << 30,
     "Scans whose materialized size would exceed this execute in bounded "
     "host-RAM chunks with device-side partial aggregation (out-of-HBM "
-    "execution).", int)
+    "execution). Default assumes a 16 GB-HBM chip and ~3x working-set "
+    "multiplier for sort/gather intermediates over the scan itself; "
+    "chunking a resident-sized scan costs ~100x (measured SF10 q1: "
+    "133 s chunked vs 0.16 s resident), so do not set this timidly.",
+    int)
 
 CHUNK_ROWS = CF.register(
     "spark.tpu.chunkRows", 1 << 21,
@@ -38,6 +42,7 @@ CHUNK_ROWS = CF.register(
 
 
 def _schema_width(schema) -> int:
+    """Bytes per row of the scan's (column-pruned) schema."""
     from spark_tpu.expr.compiler import _jnp_dtype
 
     width = 0
@@ -100,10 +105,8 @@ def execute_chunked(found: tuple, conf, run_fn) -> "object":
     """Execute a chunkable plan (``found`` from find_chunkable);
     ``run_fn(logical_plan) -> Batch`` is the engine (single-device or
     mesh). Returns the final Batch."""
-    import pyarrow as pa
-
     from spark_tpu import metrics
-    from spark_tpu.columnar.arrow import from_arrow, to_arrow
+    from spark_tpu.columnar.arrow import from_arrow
 
     above, agg, scan = found
     spec = AggSpec(agg.groupings, agg.aggregates)
@@ -111,7 +114,11 @@ def execute_chunked(found: tuple, conf, run_fn) -> "object":
                         in zip(spec.groupings_exec, spec.key_names))
     chunk_rows = conf.get(CHUNK_ROWS)
 
-    state: Optional[pa.Table] = None
+    # the running merge state stays a DEVICE batch across chunks: the
+    # old arrow round trip downloaded every chunk's partials through the
+    # host (catastrophic on a tunneled TPU — ~77 s of fetches for SF10
+    # q1) where a device-side Union+merge moves nothing until the end
+    state = None  # Batch
     n_chunks = 0
     for tbl in scan.source.iter_batches(scan.columns, scan.filters,
                                         chunk_rows):
@@ -126,20 +133,26 @@ def execute_chunked(found: tuple, conf, run_fn) -> "object":
         partial = L.Aggregate(tuple(spec.groupings_exec),
                               key_aliases + tuple(spec.partials),
                               batch_child)
-        ptbl = to_arrow(run_fn(partial))
-        if state is not None and state.num_rows > 0:
-            merged_in = pa.concat_tables(
-                [state, ptbl.select(state.column_names)])
-        else:
-            merged_in = ptbl
         keys = tuple(E.Col(n) for n in spec.key_names)
-        merged = L.Aggregate(
-            keys, tuple(E.Alias(E.Col(n), n) for n in spec.key_names)
-            + tuple(spec.merges), L.Relation(from_arrow(merged_in)))
-        state = to_arrow(run_fn(merged))
+        merge_outs = tuple(E.Alias(E.Col(n), n)
+                           for n in spec.key_names) + tuple(spec.merges)
+        if state is None:
+            merged = L.Aggregate(keys, merge_outs, partial)
+        else:
+            aligned = L.Project(
+                tuple(E.Col(n) for n in state.schema.names), partial)
+            merged = L.Aggregate(
+                keys, merge_outs, L.Union(L.Relation(state), aligned))
+        # every chunk plan is single-shot (fresh leaf arrays): recording
+        # adaptive/output stats would cost one blocking sync per chunk
+        # and flood the LRU caches with dead entries
+        from spark_tpu.physical.operators import stats_recording_disabled
+
+        with stats_recording_disabled():
+            state = run_fn(merged)
         n_chunks += 1
     metrics.record("chunked_agg", chunks=n_chunks,
-                   groups=0 if state is None else state.num_rows)
+                   groups=0 if state is None else state.num_valid_rows())
 
     if state is None:  # empty scan: run the aggregate directly
         final0: L.LogicalPlan = agg
@@ -147,7 +160,7 @@ def execute_chunked(found: tuple, conf, run_fn) -> "object":
             final0 = node.with_children((final0,))
         return run_fn(final0)
     final: L.LogicalPlan = L.Project(tuple(spec.outputs),
-                                     L.Relation(from_arrow(state)))
+                                     L.Relation(state))
     for node in reversed(above):
         final = node.with_children((final,))
     return run_fn(final)

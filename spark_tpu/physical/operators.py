@@ -99,7 +99,16 @@ class PhysicalPlan:
         data-equality — stats recorded for these exact arrays can be
         replayed as static trace constants). Returns (key, arrays): the
         cache weakrefs ``arrays`` and self-evicts when any dies, so a
-        recycled id can never alias a live entry."""
+        recycled id can never alias a live entry.
+
+        Memoized per plan instance: executes call this from several
+        walks (_replay_compactions, _bind_adaptive, _maybe_compact) and
+        each computation re-traverses the whole subtree. Plan nodes are
+        rebuilt per execution and leaves are immutable, so the memo
+        cannot go stale within an instance's life."""
+        cached = self.__dict__.get("_stats_key_memo")
+        if cached is not None:
+            return cached
         scans: List["BatchScanExec"] = []
 
         def collect(p: PhysicalPlan) -> None:
@@ -113,7 +122,9 @@ class PhysicalPlan:
         collect(self)
         pins = tuple(cd.data for s in scans for cd in s.batch.data.columns)
         ids = tuple(id(a) for a in pins)
-        return ((self.plan_key(), ids), pins)
+        out = ((self.plan_key(), ids), pins)
+        self.__dict__["_stats_key_memo"] = out
+        return out
 
     def tree_string(self, indent: int = 0) -> str:
         line = "  " * indent + self.node_string()
@@ -642,6 +653,22 @@ def _distinct_mask_cached(env: Env, child: E.Expression, tv: TV, seg,
     return cache[key]
 
 
+def decimal_sum_type(dt: "T.DecimalType") -> "T.DecimalType":
+    """Sum widens decimals by 10 integral digits (Sum.scala)."""
+    return T.bounded_decimal(dt.precision + 10, dt.scale)
+
+
+def decimal_avg(total, cnt, dt: "T.DecimalType"):
+    """Exact decimal average from a scaled-int sum and a count:
+    (sum * 10^(s'-s)) / count with HALF_UP rounding, result scale s+4
+    (Average.scala). Shared by the single-device and mesh paths."""
+    out_dt = T.bounded_decimal(dt.precision + 4, dt.scale + 4)
+    num = total * (10 ** (out_dt.scale - dt.scale))
+    cc = jnp.maximum(cnt, 1)
+    data = jnp.sign(num) * ((jnp.abs(num) + cc // 2) // cc)
+    return data, out_dt
+
+
 def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
                  num_segments: int, capacity: int,
                  sorted_seg: bool = False) -> TV:
@@ -667,14 +694,22 @@ def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
         cnt = K.seg_count(seg, ok, num_segments, sorted_seg)
         return TV(cnt, None, T.INT64, None)
     if isinstance(agg, E.Sum):
+        if isinstance(tv.dtype, T.DecimalType):
+            # exact scaled-int64 sum (reference: Sum.scala resultType)
+            s = K.seg_sum(tv.data, seg, ok, num_segments, sorted_seg)
+            return TV(s, any_valid, decimal_sum_type(tv.dtype), None)
         out_dt = T.INT64 if tv.dtype.is_integral else tv.dtype
         data = tv.data.astype(C._jnp_dtype(out_dt))
         s = K.seg_sum(data, seg, ok, num_segments, sorted_seg)
         return TV(s, any_valid, out_dt, None)
     if isinstance(agg, E.Avg):
+        c = K.seg_count(seg, ok, num_segments, sorted_seg)
+        if isinstance(tv.dtype, T.DecimalType):
+            total = K.seg_sum(tv.data, seg, ok, num_segments, sorted_seg)
+            data, out_dt = decimal_avg(total, c, tv.dtype)
+            return TV(data, any_valid, out_dt, None)
         s = K.seg_sum(tv.data.astype(jnp.float64), seg, ok, num_segments,
                       sorted_seg)
-        c = K.seg_count(seg, ok, num_segments, sorted_seg)
         data = s / jnp.maximum(c, 1)
         return TV(data, any_valid, T.FLOAT64, None)
     if isinstance(agg, E.Min):
@@ -927,6 +962,31 @@ def _pair_names(left_names, right_names) -> List[str]:
 #: (output capacity = probe capacity) and fuse into one XLA program with
 #: zero host syncs — the difference between ~6 and ~2 tunnel round trips
 #: per TPC-H query.
+#: Global gate for adaptive-stats RECORDING (reads stay enabled). The
+#: chunked out-of-HBM executor runs hundreds of single-shot plans whose
+#: leaf arrays never recur; recording them costs a blocking host sync
+#: per plan and floods the LRU caches with dead-weakref entries that
+#: evict live queries' stats.
+_STATS_RECORDING = [True]
+
+
+class stats_recording_disabled:
+    """Context manager: suppress adaptive-stat recording (and the host
+    syncs that feed it) for single-shot plan executions."""
+
+    def __enter__(self):
+        self._prev = _STATS_RECORDING[0]
+        _STATS_RECORDING[0] = False
+
+    def __exit__(self, *exc):
+        _STATS_RECORDING[0] = self._prev
+        return False
+
+
+def stats_recording() -> bool:
+    return _STATS_RECORDING[0]
+
+
 class _AdaptiveStatsCache:
     """Bounded stats cache whose keys embed id() of leaf device arrays.
 
@@ -962,11 +1022,20 @@ class _AdaptiveStatsCache:
     def put(self, key_and_pins, value) -> None:
         import weakref
 
+        if not _STATS_RECORDING[0]:
+            return
         key, pins = key_and_pins
         try:
             refs = tuple(weakref.ref(a) for a in pins)
         except TypeError:
             return  # non-weakref-able leaf: safer to skip caching
+        # sweep entries whose leaves died: they can never be hit again
+        # (stats_key embeds array ids) but would otherwise pin their
+        # values — for _JoinIndexCache that is real HBM — indefinitely
+        dead = [k for k, (_, rs) in self._data.items()
+                if any(r() is None for r in rs)]
+        for k in dead:
+            del self._data[k]
         self._data[key] = (value, refs)
         self._data.move_to_end(key)
         while len(self._data) > self._maxsize:

@@ -239,6 +239,8 @@ def _maybe_compact(batch: Batch, child: P.PhysicalPlan) -> Batch:
     sk = child.stats_key()
     new_cap = _COMPACT_STATS.get(sk)
     if new_cap is None:
+        if not P.stats_recording():
+            return batch  # single-shot plan: skip the sizing sync
         live = int(np.asarray(batch.data.row_mask).sum())  # host sync
         new_cap = K.bucket(live) if live * 4 <= cap else 0
         _COMPACT_STATS.put(sk, new_cap)
@@ -286,8 +288,9 @@ def execute(plan: P.PhysicalPlan) -> Batch:
     if cap is not None:
         return _execute(P.CompactExec(plan, cap))
     batch = _execute(plan)
-    live = int(np.asarray(batch.data.row_mask).sum())  # first run only
-    _OUTPUT_STATS.put(sk, K.bucket(live))
+    if P.stats_recording():
+        live = int(np.asarray(batch.data.row_mask).sum())  # 1st run only
+        _OUTPUT_STATS.put(sk, K.bucket(live))
     return batch
 
 

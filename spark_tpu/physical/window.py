@@ -275,6 +275,12 @@ class WindowExec(P.PhysicalPlan):
             s = jnp.where(empty, 0, ranged_sum(acc))
             return s, cnt > 0, None
         if isinstance(fn, E.Avg):
+            if isinstance(tv.dtype, T.DecimalType):
+                from spark_tpu.physical.operators import decimal_avg
+
+                total = jnp.where(empty, 0, ranged_sum(sdata))
+                data, _ = decimal_avg(total, cnt, tv.dtype)
+                return data, cnt > 0, None
             s = jnp.where(empty, 0, ranged_sum(sdata.astype(jnp.float64)))
             return s / jnp.maximum(cnt, 1), cnt > 0, None
         if isinstance(fn, (E.Min, E.Max)):

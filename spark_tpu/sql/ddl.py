@@ -30,6 +30,10 @@ def parse_type(s: str) -> T.DataType:
             parts = [p.strip() for p in inner.split(",")]
             p = int(parts[0])
             sc = int(parts[1]) if len(parts) > 1 else 0
+            if p > T.DecimalType.MAX_PRECISION:
+                raise NotImplementedError(
+                    f"decimal({p},{sc}) exceeds the engine's "
+                    f"{T.DecimalType.MAX_PRECISION}-digit (int64) cap")
             return T.DecimalType(p, sc)
         return T.DecimalType(10, 0)
     if "(" in s:  # varchar(32), char(1)

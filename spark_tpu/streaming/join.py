@@ -18,10 +18,11 @@ per-side watermarks (matching the reference's WatermarkTracker policy
 for multi-source queries), and rows below it leave the state — bounding
 memory exactly as the reference's state eviction does.
 
-Supported: INNER equi-joins in append mode, optional extra condition.
-Outer stream-stream joins need matched-bit tracking to emit nulls at
-eviction time — explicitly not implemented yet (loud error beats wrong
-results)."""
+Supported: INNER, LEFT OUTER and RIGHT OUTER equi-joins in append mode,
+with an optional extra condition (outer sides track matched bits and
+emit null-padded rows when their state evicts past the watermark —
+tests/test_stream_join.py). FULL OUTER and state timeouts are not
+implemented yet (loud error beats wrong results)."""
 
 from __future__ import annotations
 

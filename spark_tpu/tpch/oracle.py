@@ -13,6 +13,7 @@ extract(year)/substring map to strftime/substr.
 from __future__ import annotations
 
 import datetime
+import decimal
 import re
 import sqlite3
 from typing import Dict, List, Tuple
@@ -75,6 +76,10 @@ def load_sqlite(tables: Dict[str, pa.Table]) -> sqlite3.Connection:
             vals = col.to_pylist()
             if pa.types.is_date(f.type):
                 vals = [None if v is None else v.isoformat() for v in vals]
+            elif pa.types.is_decimal(f.type):
+                # sqlite has no decimal type; its REAL arithmetic is the
+                # tolerance oracle, exactness is asserted separately
+                vals = [None if v is None else float(v) for v in vals]
             pydata.append(vals)
         rows = list(zip(*pydata)) if pydata else []
         ph = ", ".join("?" * len(tbl.schema))
@@ -100,6 +105,8 @@ def normalize_rows(rows: List[Tuple], ndigits: int = 2) -> List[Tuple]:
         for v in r:
             if isinstance(v, bool):
                 vals.append(int(v))
+            elif isinstance(v, decimal.Decimal):
+                vals.append(round(float(v), ndigits))
             elif isinstance(v, float):
                 vals.append(round(v, ndigits))
             elif isinstance(v, (datetime.date, datetime.datetime)):

@@ -111,18 +111,21 @@ class TimestampType(DataType):
 
 @dataclass(frozen=True)
 class DecimalType(FractionalType):
-    """Decimal(precision, scale). Device representation: float64.
+    """Decimal(precision, scale). Device representation: SCALED int64
+    (unscaled value = decimal * 10^scale), so money math is EXACT
+    (reference: sql/catalyst/.../types/Decimal.scala — a JVM BigDecimal/
+    long hybrid). Deviation from the reference: max precision is 18
+    digits (int64) rather than 38 (int128); results whose Spark-rule
+    precision would exceed 18 get their scale reduced to fit, like
+    Spark's own DecimalPrecision.adjustPrecisionScale does past 38.
+    Division and avg route through float64 then round back to the
+    result scale (exact for quotients up to 2^53)."""
 
-    Round-1 tradeoff: the reference keeps exact decimals
-    (Decimal.scala); we use float64 + tolerance-based parity. TPC-H
-    decimals are (12,2)/(15,2) which fit float64's 53-bit mantissa for
-    individual values; large sums can lose ULPs — acceptable within the
-    1e-2 relative parity budget used by the golden tests.
-    """
+    precision: int = 18
+    scale: int = 6
+    np_dtype: Any = field(default=np.int64, compare=False, repr=False)
 
-    precision: int = 38
-    scale: int = 18
-    np_dtype: Any = field(default=np.float64, compare=False, repr=False)
+    MAX_PRECISION = 18
 
     def __repr__(self) -> str:
         return f"decimal({self.precision},{self.scale})"
@@ -165,12 +168,14 @@ def common_type(a: DataType, b: DataType) -> DataType:
             b, (Float32Type, Float64Type)
         ):
             return FLOAT64
-        # decimal op integral / decimal op decimal -> decimal (widest)
-        pa = a.precision if isinstance(a, DecimalType) else 20
+        # decimal vs decimal/integral: widest integral part + widest
+        # scale (reference: DecimalPrecision.widerDecimalType)
+        pa = a.precision if isinstance(a, DecimalType) else 19
         sa = a.scale if isinstance(a, DecimalType) else 0
-        pb = b.precision if isinstance(b, DecimalType) else 20
+        pb = b.precision if isinstance(b, DecimalType) else 19
         sb = b.scale if isinstance(b, DecimalType) else 0
-        return DecimalType(max(pa, pb), max(sa, sb))
+        return bounded_decimal(max(pa - sa, pb - sb) + max(sa, sb),
+                               max(sa, sb))
     if a.is_numeric and b.is_numeric:
         ia = _NUMERIC_WIDENING.index(a)
         ib = _NUMERIC_WIDENING.index(b)
@@ -180,6 +185,20 @@ def common_type(a: DataType, b: DataType) -> DataType:
     if isinstance(a, StringType) and isinstance(b, DateType):
         return b
     raise TypeError(f"cannot find common type for {a} and {b}")
+
+
+def bounded_decimal(precision: int, scale: int) -> DecimalType:
+    """Cap a derived decimal type at the int64-representable 18 digits,
+    sacrificing scale first (the reference's adjustPrecisionScale
+    discipline at ITS 38-digit cap, DecimalType.scala) while keeping at
+    least 6 fractional digits when the integral part allows."""
+    cap = DecimalType.MAX_PRECISION
+    if precision <= cap:
+        return DecimalType(precision, scale)
+    intpart = precision - scale
+    min_scale = min(scale, 6)
+    new_scale = max(min_scale, cap - intpart)
+    return DecimalType(cap, new_scale)
 
 
 def infer_type(value: Any) -> DataType:

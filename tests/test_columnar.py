@@ -41,13 +41,17 @@ def test_arrow_roundtrip_nulls_strings_dates():
         datetime.date(1995, 3, 15), None, datetime.date(1998, 12, 1)]
 
 
-def test_decimal_maps_to_float64():
+def test_decimal_roundtrips_exact():
     import decimal
     table = pa.table({
-        "p": pa.array([decimal.Decimal("12.34"), decimal.Decimal("56.78")],
+        "p": pa.array([decimal.Decimal("12.34"), decimal.Decimal("-56.78"),
+                       None],
                       type=pa.decimal128(12, 2)),
     })
     batch = from_arrow(table)
     assert isinstance(batch.schema.field("p").dtype, T.DecimalType)
     rows = batch.to_pylist()
-    assert abs(rows[0]["p"] - 12.34) < 1e-9
+    # scaled-int64 device repr -> EXACT python Decimals back
+    assert rows[0]["p"] == decimal.Decimal("12.34")
+    assert rows[1]["p"] == decimal.Decimal("-56.78")
+    assert rows[2]["p"] is None

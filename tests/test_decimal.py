@@ -1,0 +1,168 @@
+"""Exact decimal arithmetic (reference: sql/catalyst/.../types/
+Decimal.scala + expressions/decimalExpressions.scala + DecimalPrecision
+rules). The engine represents Decimal(p<=18, s) as scaled int64 on
+device; money math must be EXACT — verified here with EQUALITY (no
+tolerance) against integer arithmetic done independently in numpy/
+python-decimal over the same inputs."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu.api import functions as F
+
+D = decimal.Decimal
+
+
+@pytest.fixture(scope="module")
+def money_df(spark):
+    rng = np.random.default_rng(3)
+    n = 20_000
+    cents = rng.integers(-10_000_00, 100_000_00, n)
+    disc = rng.integers(0, 11, n)  # 0.00 .. 0.10
+    qty = rng.integers(1, 51, n)
+
+    def dec_col(unscaled, typ):
+        buf = np.empty((len(unscaled), 2), dtype=np.int64)
+        buf[:, 0] = unscaled
+        buf[:, 1] = np.where(unscaled < 0, -1, 0)
+        return pa.Array.from_buffers(
+            typ, len(unscaled), [None, pa.py_buffer(buf.tobytes())])
+
+    tbl = pa.table({
+        "price": dec_col(cents, pa.decimal128(12, 2)),
+        "disc": dec_col(disc, pa.decimal128(12, 2)),
+        "qty": pa.array(qty, pa.int64()),
+    })
+    df = spark.createDataFrame(tbl)
+    df.createOrReplaceTempView("money")
+    return df, cents, disc, qty
+
+
+def test_sum_exact_no_tolerance(money_df, spark):
+    df, cents, disc, qty = money_df
+    got = df.agg(F.sum(F.col("price")).alias("s")).collect()[0]["s"]
+    want = D(int(cents.sum())).scaleb(-2)
+    assert got == want  # EXACT equality, not approx
+    assert isinstance(got, decimal.Decimal)
+
+
+def test_q1_shape_exact(money_df, spark):
+    """sum(price * (1 - disc)) — the TPC-H q1/q3/q5 revenue shape —
+    exactly equals integer arithmetic at scale 4."""
+    df, cents, disc, qty = money_df
+    got = spark.sql(
+        "select sum(price * (1 - disc)) as rev from money"
+    ).collect()[0]["rev"]
+    # integer oracle: price(s2) * (1-disc)(s2) -> unscaled at s4
+    want_unscaled = int((cents * (100 - disc)).sum())
+    assert got == D(want_unscaled).scaleb(-4)
+
+
+def test_mul_scale_and_precision(spark):
+    tbl = pa.table({"a": pa.array([D("1.25")], pa.decimal128(5, 2)),
+                    "b": pa.array([D("0.5")], pa.decimal128(5, 1))})
+    d = spark.createDataFrame(tbl)
+    r = d.select((F.col("a") * F.col("b")).alias("v")).collect()[0]["v"]
+    assert r == D("0.625")  # scale 3, exact
+
+
+def test_add_aligns_scales(spark):
+    tbl = pa.table({"a": pa.array([D("1.25")], pa.decimal128(5, 2)),
+                    "b": pa.array([D("0.5")], pa.decimal128(5, 1))})
+    d = spark.createDataFrame(tbl)
+    r = d.select((F.col("a") + F.col("b")).alias("v")).collect()[0]["v"]
+    assert r == D("1.75")
+    r2 = d.select((F.col("a") - F.col("b")).alias("v")).collect()[0]["v"]
+    assert r2 == D("0.75")
+
+
+def test_div_rounds_half_up(spark):
+    tbl = pa.table({"a": pa.array([D("1.00")], pa.decimal128(5, 2))})
+    d = spark.createDataFrame(tbl)
+    r = d.select((F.col("a") / F.lit(3)).alias("v")).collect()[0]["v"]
+    # Spark rule gives (25, 22); the engine's 18-digit cap reduces the
+    # scale to fit the integral part: (18, 15)
+    assert r == D("0.333333333333333")
+    r2 = d.select((F.col("a") / F.lit(-3)).alias("v")).collect()[0]["v"]
+    assert r2 == D("-0.333333333333333")
+
+
+def test_avg_exact_half_up(spark):
+    tbl = pa.table({"a": pa.array([D("0.01"), D("0.02")],
+                                  pa.decimal128(5, 2))})
+    d = spark.createDataFrame(tbl)
+    r = d.agg(F.avg("a").alias("v")).collect()[0]["v"]
+    assert r == D("0.015000")  # scale +4, exact
+
+
+def test_compare_across_scales(spark):
+    tbl = pa.table({"a": pa.array([D("1.20")], pa.decimal128(5, 2)),
+                    "b": pa.array([D("1.2")], pa.decimal128(5, 1))})
+    d = spark.createDataFrame(tbl)
+    assert d.filter(F.col("a") == F.col("b")).count() == 1
+    assert d.filter(F.col("a") > F.col("b")).count() == 0
+
+
+def test_decimal_float_literal_predicates(spark):
+    """WHERE disc between .05 and .07 — float literals against decimal
+    columns (the q6 predicate shape)."""
+    tbl = pa.table({"disc": pa.array([D("0.04"), D("0.05"), D("0.06"),
+                                      D("0.07"), D("0.08")],
+                                     pa.decimal128(12, 2))})
+    d = spark.createDataFrame(tbl)
+    d.createOrReplaceTempView("disc_t")
+    got = spark.sql("select count(*) as c from disc_t "
+                    "where disc between 0.05 and 0.07").collect()[0]["c"]
+    assert got == 3
+
+
+def test_sum_beats_float64_drift(spark):
+    """A sum float64 cannot represent exactly, computed exactly by the
+    scaled-int path (the reason decimals exist)."""
+    n = 100_000
+    cents = np.full(n, 10_000_000_01, dtype=np.int64)  # 100000000.01
+    buf = np.empty((n, 2), dtype=np.int64)
+    buf[:, 0] = cents
+    buf[:, 1] = 0
+    arr = pa.Array.from_buffers(pa.decimal128(14, 2), n,
+                                [None, pa.py_buffer(buf.tobytes())])
+    d = spark.createDataFrame(pa.table({"v": arr}))
+    got = d.agg(F.sum("v").alias("s")).collect()[0]["s"]
+    want = D(int(cents.sum())).scaleb(-2)
+    assert got == want
+    # the float64 path would drift at this magnitude
+    assert float(got) != float(want) or True  # documentation, not assert
+
+
+def test_window_avg_decimal_exact(spark):
+    tbl = pa.table({
+        "k": pa.array([1, 1, 2], pa.int64()),
+        "v": pa.array([D("1.00"), D("2.00"), D("5.50")],
+                      pa.decimal128(5, 2))})
+    d = spark.createDataFrame(tbl)
+    d.createOrReplaceTempView("wavg")
+    rows = spark.sql(
+        "select k, avg(v) over (partition by k) as a, "
+        "sum(v) over (partition by k) as s from wavg order by k"
+    ).collect()
+    assert rows[0]["a"] == D("1.500000") and rows[0]["s"] == D("3.00")
+    assert rows[2]["a"] == D("5.500000") and rows[2]["s"] == D("5.50")
+
+
+def test_wide_decimal_rejected_loudly(spark):
+    tbl = pa.table({"x": pa.array([decimal.Decimal("1.0")],
+                                  pa.decimal128(38, 18))})
+    with pytest.raises(NotImplementedError, match="18-digit"):
+        spark.createDataFrame(tbl).collect()
+
+
+def test_to_arrow_decimal_roundtrip_nulls(spark):
+    from spark_tpu.columnar.arrow import from_arrow, to_arrow
+
+    tbl = pa.table({"m": pa.array([D("1.23"), None, D("-4.56")],
+                                  pa.decimal128(12, 2))})
+    out = to_arrow(from_arrow(tbl))
+    assert out.column("m").to_pylist() == [D("1.23"), None, D("-4.56")]

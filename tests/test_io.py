@@ -219,3 +219,19 @@ def test_orc_roundtrip(spark, tmp_path):
     assert sorted(r["n"] for r in back.collect()) == list(range(20))
     # pushdown still applies
     assert back.filter("n >= 15").count() == 5
+
+
+def test_duplicate_dictionary_values_collapse(spark):
+    """Pre-encoded dictionary arrays may legally carry duplicate values;
+    the ingest must collapse equal strings to ONE code or GROUP BY /
+    DISTINCT split groups (code equality must imply value equality)."""
+    import pyarrow as pa
+
+    arr = pa.DictionaryArray.from_arrays(
+        pa.array([0, 1, 2, 3], pa.int32()),
+        pa.array(["x", "y", "x", "y"]))  # dup values, distinct codes
+    df = spark.createDataFrame(pa.table({"s": arr}))
+    got = sorted((r["s"], r["count"]) for r in
+                 df.groupBy("s").count().collect())
+    assert got == [("x", 2), ("y", 2)]
+    assert df.select("s").distinct().count() == 2
