@@ -538,3 +538,38 @@ def asc_nulls_last(c: ColumnOrName) -> Column:
 
 def desc_nulls_first(c: ColumnOrName) -> Column:
     return E.SortOrder(_c(c), ascending=False, nulls_first=True)
+
+
+# ---- complex types (arrays / generators) ------------------------------------
+# Reference: collectionOperations.scala, complexTypeCreator.scala,
+# generators.scala / GenerateExec.scala:1.
+
+
+def array(*cols: ColumnOrName) -> Column:
+    return E.MakeArray(tuple(_c(c) for c in cols))
+
+
+def split(c: ColumnOrName, delim: str) -> Column:
+    return E.Split(_c(c), str(delim))
+
+
+def size(c: ColumnOrName) -> Column:
+    return E.Size(_c(c))
+
+
+def element_at(c: ColumnOrName, index) -> Column:
+    ix = index if isinstance(index, E.Expression) else E.Literal(int(index))
+    return E.ElementAt(_c(c), ix)
+
+
+def array_contains(c: ColumnOrName, value) -> Column:
+    v = value if isinstance(value, E.Expression) else E.Literal(value)
+    return E.ArrayContains(_c(c), v)
+
+
+def explode(c: ColumnOrName) -> Column:
+    return E.Explode(_c(c))
+
+
+def posexplode(c: ColumnOrName) -> Column:
+    return E.Explode(_c(c), with_position=True)

@@ -84,6 +84,8 @@ def dtype_to_arrow_type(dt: T.DataType) -> pa.DataType:
         return pa.date32()
     if isinstance(dt, T.TimestampType):
         return pa.timestamp("us")
+    if isinstance(dt, T.ArrayType):
+        return pa.list_(dtype_to_arrow_type(dt.element))
     raise TypeError(f"unsupported dtype: {dt}")
 
 
@@ -183,18 +185,86 @@ def _column_to_numpy(
     return values.astype(dtype.np_dtype, copy=False), validity, dictionary
 
 
+def _list_to_padded(col: pa.ChunkedArray):
+    """Arrow list column -> (values 2D padded, lengths, validity,
+    element dictionary, element dtype). The PADDED layout is the
+    ArrayType contract (types.ArrayType)."""
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    validity = None
+    if col.null_count > 0:
+        validity = pc.is_valid(col).to_numpy(zero_copy_only=False)
+    # ABSOLUTE offsets into col.values: flatten() would DROP null rows'
+    # value ranges (legal Arrow) and silently misalign every later row
+    offsets = col.offsets.to_numpy(zero_copy_only=False).astype(np.int64)
+    lengths = np.diff(offsets).astype(np.int32)
+    if validity is not None:
+        lengths = np.where(validity, lengths, 0).astype(np.int32)
+    el_dtype = arrow_type_to_dtype(col.type.value_type)
+    fvals, _, dictionary = _column_to_numpy(
+        pa.chunked_array([col.values]), el_dtype)
+    n = len(col)
+    max_len = max(1, int(lengths.max()) if n else 1)
+    vals = np.zeros((n, max_len), dtype=fvals.dtype)
+    if len(fvals):
+        # row-major gather of each row's slice (vectorized by mask)
+        jj = np.arange(max_len)[None, :]
+        take = offsets[:-1, None] + jj
+        alive = jj < lengths[:, None]
+        vals[alive] = fvals[np.clip(take, 0, len(fvals) - 1)][alive]
+    return vals, lengths, validity, dictionary, el_dtype
+
+
 def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> Batch:
-    """Arrow table -> device Batch (pads to bucketed capacity)."""
+    """Arrow table -> device Batch (pads to bucketed capacity). List
+    columns become padded-2D ArrayType columns plus a hidden '#len'
+    companion; struct columns FLATTEN into dotted children (reference
+    peers: UnsafeArrayData / nested schema pruning)."""
     fields = []
     arrays = []
     validities = []
-    for name, col in zip(table.column_names, table.columns):
+
+    def add(name, col, parent_valid=None):
+        if pa.types.is_struct(col.type):
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            pv = parent_valid
+            if col.null_count > 0:
+                sv = pc.is_valid(col).to_numpy(zero_copy_only=False)
+                pv = sv if pv is None else (pv & sv)
+            for i, f in enumerate(col.type):
+                add(f"{name}.{f.name}", col.field(i), pv)
+            return
+        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+            vals, lengths, validity, dictionary, el_dtype = \
+                _list_to_padded(col)
+            if parent_valid is not None:
+                validity = (parent_valid if validity is None
+                            else (validity & parent_valid))
+                lengths = np.where(validity, lengths, 0).astype(np.int32)
+            fields.append(Field(name, T.ArrayType(el_dtype),
+                                nullable=validity is not None,
+                                dictionary=dictionary))
+            arrays.append(vals)
+            validities.append(validity)
+            fields.append(Field(T.array_len_col(name), T.INT32,
+                                nullable=False))
+            arrays.append(lengths)
+            validities.append(None)
+            return
         dtype = arrow_type_to_dtype(col.type)
         values, validity, dictionary = _column_to_numpy(col, dtype)
+        if parent_valid is not None:
+            # a NULL struct row means every child field is NULL
+            validity = (parent_valid if validity is None
+                        else (validity & parent_valid))
         fields.append(Field(name, dtype, nullable=validity is not None,
                             dictionary=dictionary))
         arrays.append(values)
         validities.append(validity)
+
+    for name, col in zip(table.column_names, table.columns):
+        add(name, col)
     schema = Schema(tuple(fields))
     return from_numpy(schema, arrays, validities, capacity=capacity)
 
@@ -209,11 +279,51 @@ def schema_from_arrow(pa_schema: "pa.Schema") -> Schema:
 
 def to_arrow(batch: Batch) -> pa.Table:
     """Device Batch -> Arrow table with only live rows (whole batch
-    fetched in ONE device->host transfer, see Batch.fetch_host)."""
+    fetched in ONE device->host transfer, see Batch.fetch_host). Array
+    columns rebuild arrow lists from the padded 2D layout + '#len'
+    companion (which is dropped from the output)."""
     mask, host_cols = batch.fetch_host()
     columns = []
     names = []
+    by_name = {f.name: hc for f, hc in zip(batch.schema.fields,
+                                           host_cols)}
+    hidden = {T.array_len_col(f.name) for f in batch.schema.fields
+              if isinstance(f.dtype, T.ArrayType)}
     for f, (cdata, cvalid) in zip(batch.schema.fields, host_cols):
+        if f.name in hidden:
+            continue
+        if isinstance(f.dtype, T.ArrayType):
+            data = cdata[mask]
+            valid = None if cvalid is None else cvalid[mask]
+            comp = by_name.get(T.array_len_col(f.name))
+            lens = (comp[0][mask].astype(np.int64) if comp is not None
+                    else np.full(len(data), data.shape[1], np.int64))
+            if valid is not None:
+                lens = np.where(valid, lens, 0)
+            offsets = np.zeros(len(data) + 1, dtype=np.int32)
+            np.cumsum(lens, out=offsets[1:])
+            jj = np.arange(data.shape[1])[None, :]
+            alive = jj < lens[:, None]
+            flat = data[alive]
+            if isinstance(f.dtype.element, T.StringType):
+                d = list(f.dictionary or ())
+                values = pa.DictionaryArray.from_arrays(
+                    pa.array(flat.astype(np.int32), pa.int32()),
+                    pa.array(d, pa.string())).cast(pa.string())
+            else:
+                values = pa.array(
+                    flat, type=dtype_to_arrow_type(f.dtype.element))
+            arr = pa.ListArray.from_arrays(
+                pa.array(offsets, pa.int32()), values)
+            if valid is not None and not valid.all():
+                # rebuild with a validity bitmap (from_arrays has no
+                # mask parameter that keeps offsets aligned)
+                arr = pa.ListArray.from_arrays(
+                    pa.array(offsets, pa.int32()), values,
+                    mask=pa.array(~valid))
+            columns.append(arr)
+            names.append(f.name)
+            continue
         data = cdata[mask]
         valid = None if cvalid is None else cvalid[mask]
         if isinstance(f.dtype, T.StringType):

@@ -117,8 +117,12 @@ class Batch:
         plan = [("i", 0, jnp.bool_)]  # (plane, slot, dtype) for mask
         int_arrays = [self.data.row_mask]
         flt_arrays = []
+        extra_arrays = []  # 2D array columns: fetched individually
         for cd in cols:
-            if jnp.issubdtype(cd.data.dtype, jnp.floating):
+            if cd.data.ndim > 1:
+                plan.append(("x", len(extra_arrays), cd.data.dtype))
+                extra_arrays.append(cd.data)
+            elif jnp.issubdtype(cd.data.dtype, jnp.floating):
                 plan.append(("f", len(flt_arrays), cd.data.dtype))
                 flt_arrays.append(cd.data)
             else:
@@ -154,7 +158,11 @@ class Batch:
             ih = np.asarray(iplane)
             fh = np.zeros((0, 0), dtype=np.float64)
 
+        xh = [np.asarray(a) for a in extra_arrays]  # one RTT each
+
         def restore(plane, slot, dt):
+            if plane == "x":
+                return xh[slot]
             row = ih[slot] if plane == "i" else fh[slot]
             if dt == jnp.bool_:
                 return row.astype(bool)
@@ -178,20 +186,48 @@ class Batch:
         dictionaries and dates). For tests and `.collect()`."""
         import datetime
 
-        from spark_tpu.types import (DateType, DecimalType, StringType,
-                                     TimestampType)
+        from spark_tpu.types import (ArrayType, DateType, DecimalType,
+                                     StringType, TimestampType,
+                                     array_len_col)
 
         mask, host_cols = self.fetch_host()
         out_rows: list = []
         cols = []
-        for f, (cdata, cvalid) in zip(self.schema.fields, host_cols):
+        by_name = {f.name: hc for f, hc in zip(self.schema.fields,
+                                               host_cols)}
+        hidden = {array_len_col(f.name) for f in self.schema.fields
+                  if isinstance(f.dtype, ArrayType)}
+        out_fields = [f for f in self.schema.fields
+                      if f.name not in hidden]
+        for f in out_fields:
+            cdata, cvalid = by_name[f.name]
             data = cdata[mask]
             valid = (
                 np.ones(len(data), dtype=bool)
                 if cvalid is None
                 else cvalid[mask]
             )
-            if isinstance(f.dtype, StringType):
+            if isinstance(f.dtype, ArrayType):
+                comp = by_name.get(array_len_col(f.name))
+                lens = (comp[0][mask] if comp is not None
+                        else np.full(len(data), data.shape[1]))
+
+                def el(x):
+                    if isinstance(f.dtype.element, StringType):
+                        d = f.dictionary or ()
+                        return d[x] if 0 <= x < len(d) else None
+                    if isinstance(f.dtype.element, DecimalType):
+                        import decimal as _d
+
+                        return _d.Decimal(int(x)).scaleb(
+                            -f.dtype.element.scale)
+                    return x.item() if hasattr(x, "item") else x
+
+                vals = [
+                    [el(x) for x in row[:int(ln)]] if v else None
+                    for row, ln, v in zip(data, lens, valid)
+                ]
+            elif isinstance(f.dtype, StringType):
                 dictionary = f.dictionary or ()
                 vals = [
                     dictionary[c] if (v and 0 <= c < len(dictionary)) else None
@@ -222,7 +258,7 @@ class Batch:
             cols.append(vals)
         for i in range(len(cols[0]) if cols else 0):
             out_rows.append(
-                {f.name: cols[j][i] for j, f in enumerate(self.schema.fields)}
+                {f.name: cols[j][i] for j, f in enumerate(out_fields)}
             )
         return out_rows
 
@@ -259,8 +295,9 @@ def from_numpy(
 
     cols = []
     for f, arr, val in zip(schema.fields, arrays, validities):
-        np_dt = f.dtype.np_dtype
-        padded = np.zeros((cap,), dtype=np_dt)
+        np_dt = arr.dtype if arr.ndim > 1 else f.dtype.np_dtype
+        shape = (cap,) + tuple(arr.shape[1:])
+        padded = np.zeros(shape, dtype=np_dt)
         padded[:n] = arr.astype(np_dt, copy=False)
         v = None
         if val is not None:

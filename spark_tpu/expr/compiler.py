@@ -28,12 +28,17 @@ from spark_tpu.types import DataType
 
 
 class TV(NamedTuple):
-    """Typed value: device data + validity + host metadata."""
+    """Typed value: device data + validity + host metadata.
+
+    Array-typed TVs carry 2D ``data`` (capacity, max_len) plus per-row
+    ``lengths``; at batch boundaries the lengths ride as a hidden
+    '<col>#len' companion column (types.ArrayType)."""
 
     data: jnp.ndarray
     validity: Optional[jnp.ndarray]  # None = all valid
     dtype: DataType
     dictionary: Optional[Tuple[str, ...]] = None
+    lengths: Optional[jnp.ndarray] = None  # int32[capacity], arrays only
 
     def valid_or_true(self, n: int) -> jnp.ndarray:
         if self.validity is None:
@@ -54,8 +59,15 @@ class Env:
     @classmethod
     def from_batch(cls, batch) -> "Env":
         cols = {}
-        for f, cd in zip(batch.schema.fields, batch.data.columns):
-            cols[f.name] = TV(cd.data, cd.validity, f.dtype, f.dictionary)
+        fields = list(zip(batch.schema.fields, batch.data.columns))
+        by_name = {f.name: cd for f, cd in fields}
+        for f, cd in fields:
+            lengths = None
+            if isinstance(f.dtype, T.ArrayType):
+                comp = by_name.get(T.array_len_col(f.name))
+                lengths = None if comp is None else comp.data
+            cols[f.name] = TV(cd.data, cd.validity, f.dtype,
+                              f.dictionary, lengths)
         return cls(cols, batch.capacity)
 
 
@@ -235,10 +247,17 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
 
     if isinstance(expr, E.Col):
         try:
-            return env.columns[expr.col_name]
+            tv = env.columns[expr.col_name]
         except KeyError:
             raise KeyError(
                 f"column {expr.col_name!r} not in {sorted(env.columns)}")
+        if isinstance(tv.dtype, T.ArrayType) and tv.lengths is None:
+            # fold the hidden '#len' companion back into the TV: pipes
+            # built from batches carry lengths as an ordinary column
+            comp = env.columns.get(T.array_len_col(expr.col_name))
+            if comp is not None:
+                tv = tv._replace(lengths=comp.data)
+        return tv
 
     if isinstance(expr, E.Alias):
         return evaluate(expr.child, env)
@@ -314,6 +333,104 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
         if tv.validity is None:
             return TV(jnp.zeros((n,), dtype=jnp.bool_), None, T.BOOLEAN, None)
         return TV(~tv.validity, None, T.BOOLEAN, None)
+
+    if isinstance(expr, E.MakeArray):
+        tvs = [evaluate(a, env) for a in expr.args]
+        if any(t.validity is not None for t in tvs):
+            # null ELEMENTS inside arrays are not representable in the
+            # padded layout (types.ArrayType) — Spark's CreateArray
+            # would keep [1, NULL]; silently nulling the whole array
+            # gives wrong size()/element_at results, so refuse loudly
+            raise NotImplementedError(
+                "array() over nullable inputs: null elements are not "
+                "representable — coalesce() the inputs first")
+        el = tvs[0].dtype
+        for t in tvs[1:]:
+            el = T.common_type(el, t.dtype)
+        if isinstance(el, T.StringType):
+            union, tables = unify_dictionaries(
+                tuple(t.dictionary or () for t in tvs))
+            cols = [(jnp.asarray(tb)[t.data] if len(t.dictionary or ())
+                     else t.data) for t, tb in zip(tvs, tables)]
+            dictionary: Optional[Tuple[str, ...]] = union
+        else:
+            cols = [_cast_data(t.data, t.dtype, el) for t in tvs]
+            dictionary = None
+        data = jnp.stack(cols, axis=1)
+        lengths = jnp.full((n,), len(tvs), dtype=jnp.int32)
+        return TV(data, None, T.ArrayType(el), dictionary, lengths)
+
+    if isinstance(expr, E.Split):
+        tv = evaluate(expr.child, env)
+        if not isinstance(tv.dtype, T.StringType):
+            raise NotImplementedError("split() needs a string input")
+        dictionary = tv.dictionary or ()
+        parts = [s.split(expr.delim) for s in dictionary]
+        max_len = max((len(p) for p in parts), default=1)
+        el_dict = tuple(sorted({w for p in parts for w in p}))
+        pos = {s: i for i, s in enumerate(el_dict)}
+        vals = np.zeros((max(1, len(parts)), max_len), dtype=np.int32)
+        lens = np.zeros((max(1, len(parts)),), dtype=np.int32)
+        for i, p in enumerate(parts):
+            lens[i] = len(p)
+            for j, w in enumerate(p):
+                vals[i, j] = pos[w]
+        codes = tv.data if len(dictionary) else jnp.zeros((n,), jnp.int32)
+        return TV(jnp.asarray(vals)[codes], tv.validity,
+                  T.ArrayType(T.STRING), el_dict,
+                  jnp.asarray(lens)[codes])
+
+    if isinstance(expr, E.Size):
+        tv = evaluate(expr.child, env)
+        if tv.lengths is None:
+            raise NotImplementedError("size() over a non-array value")
+        return TV(tv.lengths.astype(jnp.int32), tv.validity, T.INT32,
+                  None)
+
+    if isinstance(expr, E.ElementAt):
+        tv = evaluate(expr.child, env)
+        it = evaluate(expr.index, env)
+        if tv.lengths is None or tv.data.ndim != 2:
+            raise NotImplementedError("element_at over a non-array value")
+        idx = it.data.astype(jnp.int32)
+        lens = tv.lengths.astype(jnp.int32)
+        pos = jnp.where(idx > 0, idx - 1, lens + idx)
+        ok = (pos >= 0) & (pos < lens) & (idx != 0)
+        got = jnp.take_along_axis(
+            tv.data, jnp.clip(pos, 0, max(tv.data.shape[1] - 1, 0))[:, None],
+            axis=1)[:, 0]
+        validity = tv.valid_or_true(n) & it.valid_or_true(n) & ok
+        return TV(got, validity, tv.dtype.element, tv.dictionary)
+
+    if isinstance(expr, E.ArrayContains):
+        tv = evaluate(expr.child, env)
+        vt = evaluate(expr.value, env)
+        if tv.lengths is None or tv.data.ndim != 2:
+            raise NotImplementedError(
+                "array_contains over a non-array value")
+        L = tv.data.shape[1]
+        alive = jnp.arange(L)[None, :] < tv.lengths[:, None]
+        if isinstance(tv.dtype.element, T.StringType):
+            # translate the needle into the element dictionary's codes
+            union, (ta, tb) = unify_dictionaries(
+                (tv.dictionary or (), vt.dictionary or ()))
+            adata = (jnp.asarray(ta)[tv.data]
+                     if len(tv.dictionary or ()) else tv.data)
+            vdata = (jnp.asarray(tb)[vt.data]
+                     if len(vt.dictionary or ()) else vt.data)
+            eq = adata == vdata[:, None]
+        else:
+            eq = tv.data == _cast_data(
+                vt.data, vt.dtype, tv.dtype.element)[:, None]
+        res = jnp.any(eq & alive, axis=1)
+        validity = _and_validity(tv.validity, vt.validity)
+        return TV(res, validity, T.BOOLEAN, None)
+
+    if isinstance(expr, E.Explode):
+        raise NotImplementedError(
+            "explode() is a generator: only valid in a SELECT list or "
+            "LATERAL VIEW (planned as GenerateExec), not nested inside "
+            "another expression")
 
     if isinstance(expr, E.NullOf):
         tv = evaluate(expr.like, env)

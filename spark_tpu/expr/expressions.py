@@ -413,6 +413,132 @@ class IsNull(Expression):
 
 
 @dataclass(eq=False, frozen=True)
+class MakeArray(Expression):
+    """array(e1, e2, ...) literal-ish constructor (reference:
+    CreateArray, complexTypeCreator.scala). Fixed length = arity."""
+
+    args: Tuple[Expression, ...]
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        dt = self.args[0].data_type(schema)
+        for a in self.args[1:]:
+            dt = T.common_type(dt, a.data_type(schema))
+        return T.ArrayType(dt)
+
+    def __str__(self):
+        return f"array({', '.join(map(str, self.args))})"
+
+
+@dataclass(eq=False, frozen=True)
+class Split(Expression):
+    """split(str, delim) -> array<string> (reference: StringSplit,
+    regexpExpressions.scala — here delim is a LITERAL separator, not a
+    regex; evaluated over the host dictionary)."""
+
+    child: Expression
+    delim: str
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.ArrayType(T.STRING)
+
+    def __str__(self):
+        return f"split({self.child}, {self.delim!r})"
+
+
+@dataclass(eq=False, frozen=True)
+class Size(Expression):
+    """size(array) (reference: Size, collectionOperations.scala)."""
+
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def __str__(self):
+        return f"size({self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class ElementAt(Expression):
+    """element_at(array, i): 1-based, negative from the end, NULL when
+    out of range (reference: ElementAt, collectionOperations.scala)."""
+
+    child: Expression
+    index: Expression
+
+    def children(self):
+        return (self.child, self.index)
+
+    def data_type(self, schema):
+        dt = self.child.data_type(schema)
+        if isinstance(dt, T.ArrayType):
+            return dt.element
+        raise TypeError(f"element_at over non-array {dt!r}")
+
+    def nullable(self, schema):
+        return True
+
+    def __str__(self):
+        return f"element_at({self.child}, {self.index})"
+
+
+@dataclass(eq=False, frozen=True)
+class ArrayContains(Expression):
+    """array_contains(array, value) (reference: ArrayContains)."""
+
+    child: Expression
+    value: Expression
+
+    def children(self):
+        return (self.child, self.value)
+
+    def data_type(self, schema):
+        return T.BOOLEAN
+
+    def __str__(self):
+        return f"array_contains({self.child}, {self.value})"
+
+
+@dataclass(eq=False, frozen=True)
+class Explode(Expression):
+    """Generator marker: one output row per array element (reference:
+    Explode/PosExplode, generators.scala). Only legal inside a
+    Generate plan node (physical GenerateExec); evaluating it as an
+    ordinary expression raises."""
+
+    child: Expression
+    with_position: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        dt = self.child.data_type(schema)
+        if isinstance(dt, T.ArrayType):
+            return dt.element
+        raise TypeError(f"explode over non-array {dt!r}")
+
+    def __str__(self):
+        return ("posexplode" if self.with_position else "explode") \
+            + f"({self.child})"
+
+
+def contains_generator(e: Expression) -> bool:
+    if isinstance(e, Explode):
+        return True
+    return any(contains_generator(c) for c in e.children())
+
+
+@dataclass(eq=False, frozen=True)
 class NullOf(Expression):
     """NULL typed like ``like`` (reference: Literal(null, child.dataType)
     inside NullIf's If rewrite, nullExpressions.scala). Keeps Case's

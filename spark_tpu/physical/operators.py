@@ -250,8 +250,12 @@ class ProjectExec(PhysicalPlan):
             dictionary = None
             if isinstance(inner, E.Col) and inner.col_name in cs:
                 dictionary = cs.field(inner.col_name).dictionary
-            fields.append(Field(e.name, e.data_type(cs), e.nullable(cs),
-                                dictionary))
+            dt = e.data_type(cs)
+            fields.append(Field(e.name, dt, e.nullable(cs), dictionary))
+            if isinstance(dt, T.ArrayType):
+                # hidden per-row length companion (types.ArrayType)
+                fields.append(Field(T.array_len_col(e.name), T.INT32,
+                                    nullable=False))
         return Schema(tuple(fields))
 
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
@@ -263,6 +267,14 @@ class ProjectExec(PhysicalPlan):
             tv = C.evaluate(e, env)
             cols[e.name] = tv
             order.append(e.name)
+            if isinstance(tv.dtype, T.ArrayType):
+                ln = T.array_len_col(e.name)
+                lengths = (tv.lengths if tv.lengths is not None
+                           else jnp.full((pipe.capacity,), tv.data.shape[1]
+                                         if tv.data.ndim > 1 else 0,
+                                         dtype=jnp.int32))
+                cols[ln] = TV(lengths, None, T.INT32, None)
+                order.append(ln)
         return Pipe(cols, pipe.mask, order)
 
     def node_string(self):
@@ -369,6 +381,110 @@ class LimitExec(PhysicalPlan):
 
     def plan_key(self):
         return ("Limit", self.n, self.offset, self.child.plan_key())
+
+
+@dataclass(eq=False)
+class GenerateExec(PhysicalPlan):
+    """Sized row expansion for explode/posexplode (reference:
+    execution/GenerateExec.scala:1): one output row per live array
+    element, parent columns replicated by gather — the exact shape of
+    the join pair expansion, so it reuses the same offsets+searchsorted
+    kernel and the same adaptive capacity-replay discipline (_GEN_STATS
+    records the bucketed element total for these leaves; re-executions
+    trace with a static capacity, no sizing sync)."""
+
+    generator: E.Expression  # E.Explode
+    out_name: str
+    pos_name: Optional[str]
+    child: PhysicalPlan
+    adaptive: Optional[int] = None
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def traceable(self) -> bool:  # type: ignore[override]
+        return self.adaptive is not None
+
+    @property
+    def schema(self) -> Schema:
+        from spark_tpu.plan import logical as L
+
+        return L.Generate(self.generator, self.out_name, self.pos_name,
+                          _SchemaOnly(self.child.schema)).schema
+
+    def _expand(self, pipe: Pipe, cap: int, tv=None) -> Pipe:
+        if tv is None:
+            tv = C.evaluate(self.generator.child, pipe.env())
+        if tv.lengths is None or tv.data.ndim != 2:
+            raise NotImplementedError("explode over a non-array value")
+        ok = pipe.mask & tv.valid_or_true(pipe.capacity)
+        counts = jnp.where(ok, tv.lengths.astype(jnp.int64), 0)
+        offsets = jnp.cumsum(counts) - counts
+        total = offsets[-1] + counts[-1]
+        j = jnp.arange(cap)
+        p = K.searchsorted(offsets, j, side="right") - 1
+        p = jnp.clip(p, 0, pipe.capacity - 1)
+        k = j - offsets[p]
+        out_mask = j < total
+        cols: Dict[str, TV] = {}
+        order: List[str] = []
+        for name in pipe.order:
+            src = pipe.cols[name]
+            cols[name] = TV(
+                src.data[p],
+                None if src.validity is None else src.validity[p],
+                src.dtype, src.dictionary,
+                None if src.lengths is None else src.lengths[p])
+            order.append(name)
+        if self.pos_name is not None:
+            cols[self.pos_name] = TV(k.astype(jnp.int32), None, T.INT32,
+                                     None)
+            order.append(self.pos_name)
+        el = jnp.take_along_axis(
+            tv.data[p], jnp.clip(k, 0, tv.data.shape[1] - 1)[:, None],
+            axis=1)[:, 0]
+        cols[self.out_name] = TV(el, None, tv.dtype.element,
+                                 tv.dictionary)
+        order.append(self.out_name)
+        return Pipe(cols, out_mask, order)
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        return self._expand(child_pipes[0], self.adaptive)
+
+    def execute_blocking(self, child_batches: List[Batch]) -> Batch:
+        pipe = Pipe.from_batch_data(child_batches[0].schema,
+                                    child_batches[0].data)
+        tv = C.evaluate(self.generator.child, pipe.env())
+        if tv.lengths is None:
+            raise NotImplementedError("explode over a non-array value")
+        ok = pipe.mask & tv.valid_or_true(pipe.capacity)
+        total = int(jax.device_get(jnp.sum(
+            jnp.where(ok, tv.lengths.astype(jnp.int64), 0))))
+        cap = K.bucket(total)
+        sk = self.stats_key()
+        if sk not in _GEN_STATS:
+            _GEN_STATS.put(sk, cap)
+        return self._expand(pipe, cap, tv).to_batch()
+
+    def node_string(self):
+        return f"Generate[{self.generator} AS {self.out_name}]"
+
+    def plan_key(self):
+        return ("Generate", E.expr_key(self.generator), self.out_name,
+                self.pos_name, self.child.plan_key())
+
+
+@dataclass(eq=False)
+class _SchemaOnly(PhysicalPlan):
+    """Wrap a schema as a plan-shaped object for schema composition."""
+
+    wrapped: Schema
+    traceable = False
+
+    @property
+    def schema(self) -> Schema:
+        return self.wrapped
 
 
 @dataclass(eq=False)
@@ -1086,6 +1202,10 @@ class _JoinIndexCache(_AdaptiveStatsCache):
 #: Cached join build indexes (kernels.make_join_index outputs, wrapped
 #: as aux Batches); leaf weakrefs evict entries when their data dies.
 _JOIN_INDEX = _JoinIndexCache()
+
+#: Observed explode output capacity per (plan, leaf-ids) — same replay
+#: discipline as _JOIN_STATS (GenerateExec).
+_GEN_STATS = _AdaptiveStatsCache()
 
 #: Adaptive aggregation statistics: observed group count per
 #: (plan, leaf-array-ids) — lets the sort-based aggregation path trace

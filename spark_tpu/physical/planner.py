@@ -65,6 +65,9 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
         from spark_tpu.physical.window import WindowExec
 
         return WindowExec(plan.window_exprs, plan_physical(plan.child))
+    if isinstance(plan, L.Generate):
+        return P.GenerateExec(plan.generator, plan.out_name,
+                              plan.pos_name, plan_physical(plan.child))
     if isinstance(plan, L.Join):
         return P.JoinExec(plan_physical(plan.left), plan_physical(plan.right),
                           plan.how, plan.left_keys, plan.right_keys,
@@ -143,6 +146,8 @@ def _bind_adaptive(plan: P.PhysicalPlan) -> None:
     elif isinstance(plan, P.HashAggregateExec) and plan.groupings \
             and not plan._static_direct_ok():
         plan.adaptive = P._AGG_STATS.get(plan.stats_key())
+    elif isinstance(plan, P.GenerateExec):
+        plan.adaptive = P._GEN_STATS.get(plan.stats_key())
 
 
 def _adaptive_snapshot(plan: P.PhysicalPlan) -> tuple:
@@ -159,7 +164,7 @@ def _adaptive_snapshot(plan: P.PhysicalPlan) -> tuple:
                         else p.index_scan.plan_key(),
                         None if p.table_scan is None
                         else p.table_scan.plan_key()))
-        elif isinstance(p, P.HashAggregateExec):
+        elif isinstance(p, (P.HashAggregateExec, P.GenerateExec)):
             out.append(p.adaptive)
         elif isinstance(p, P.CompactExec):
             # plan_key is transparent for stats stability; the snapshot

@@ -279,6 +279,40 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass(eq=False, frozen=True)
+class Generate(LogicalPlan):
+    """One output row per generated element, child columns replicated
+    (reference: plans/logical Generate + execution/GenerateExec.scala:1;
+    SQL surface: LATERAL VIEW explode(...) / explode in a SELECT list).
+    ``generator`` is an E.Explode; output appends [pos,] value."""
+
+    generator: E.Expression  # E.Explode
+    out_name: str
+    pos_name: Optional[str]  # set for posexplode
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @cached_property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = list(cs.fields)
+        if self.pos_name is not None:
+            fields.append(Field(self.pos_name, T.INT32, nullable=False))
+        el = self.generator.data_type(cs)
+        dictionary = None
+        inner = E.strip_alias(self.generator.child)
+        if isinstance(inner, E.Col) and inner.col_name in cs:
+            dictionary = cs.field(inner.col_name).dictionary
+        fields.append(Field(self.out_name, el, nullable=False,
+                            dictionary=dictionary))
+        return Schema(tuple(fields))
+
+    def node_string(self):
+        return f"Generate[{self.generator} AS {self.out_name}]"
+
+
+@dataclass(eq=False, frozen=True)
 class Window(LogicalPlan):
     """Append window-function columns to the child's output (reference:
     plans/logical/basicLogicalOperators.scala Window +

@@ -13,6 +13,7 @@ import dataclasses
 import datetime
 from typing import Callable, List, Tuple
 
+from spark_tpu import types as T
 from spark_tpu.expr import expressions as E
 from spark_tpu.plan import logical as L
 
@@ -425,6 +426,18 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
                 child_req = set(node.child.schema.names)
             return dataclasses.replace(
                 node, child=prune(node.child, child_req))
+        if isinstance(node, L.Generate):
+            gen_names = {node.out_name} | (
+                {node.pos_name} if node.pos_name else set())
+            child_req = {n for n in required if n not in gen_names}
+            child_req |= node.generator.references()
+            # arrays ride with a hidden '#len' companion column
+            child_req |= {T.array_len_col(n) for n in
+                          node.generator.references()}
+            child_req &= set(node.child.schema.names) | {
+                T.array_len_col(n) for n in node.child.schema.names}
+            return dataclasses.replace(
+                node, child=prune(node.child, child_req))
         if isinstance(node, (L.Sort, L.Limit, L.Distinct, L.SubqueryAlias,
                              L.Repartition, L.Sample)):
             child_req = set(required)
@@ -488,7 +501,51 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
 
 Rule = Callable[[L.LogicalPlan], L.LogicalPlan]
 
+def extract_generators(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Pull explode/posexplode out of SELECT lists into Generate nodes
+    (reference: analysis ExtractGenerator + GenerateExec planning).
+    ``select a, explode(b) as c`` becomes
+    Project[a, c] over Generate[explode(b) AS c] over child."""
+
+    def rule(node: L.LogicalPlan) -> L.LogicalPlan:
+        if not isinstance(node, L.Project):
+            return node
+        gens = [e for e in node.exprs
+                if isinstance(E.strip_alias(e), E.Explode)]
+        if not gens:
+            # generators nested inside other expressions are rejected
+            for e in node.exprs:
+                if E.contains_generator(e):
+                    raise NotImplementedError(
+                        f"generator must be a top-level SELECT item: {e}")
+            return node
+        if len(gens) > 1:
+            raise NotImplementedError(
+                "only one generator per SELECT list (the reference has "
+                "the same restriction, ExtractGenerator)")
+        gen_item = gens[0]
+        gen = E.strip_alias(gen_item)
+        out_name = gen_item.name if isinstance(gen_item, E.Alias) else "col"
+        pos_name = None
+        if gen.with_position:
+            # posexplode yields (pos, col); an alias names the value col
+            pos_name = "pos"
+        g = L.Generate(gen, out_name, pos_name, node.child)
+        new_exprs = []
+        for e in node.exprs:
+            if e is gen_item:
+                if pos_name is not None:
+                    new_exprs.append(E.Col(pos_name))
+                new_exprs.append(E.Col(out_name))
+            else:
+                new_exprs.append(e)
+        return L.Project(tuple(new_exprs), g)
+
+    return plan.transform_up(rule)
+
+
 _FIXED_POINT_BATCH: Tuple[Rule, ...] = (
+    extract_generators,
     constant_folding,
     simplify_booleans,
     push_down_predicates,

@@ -78,6 +78,7 @@ _RESERVED_STOP = {
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "OUTER", "UNION",
     "INTERSECT", "EXCEPT", "AS", "AND", "OR", "NOT", "BY", "ASC", "DESC",
     "THEN", "WHEN", "ELSE", "END", "USING", "SEMI", "ANTI", "NULLS",
+    "LATERAL",
 }
 
 
@@ -689,6 +690,12 @@ class _ExprParser:
             r = self._str_literal()
             self.expect(")")
             return E.StringTransform("translate", e, (m, r))
+        if name == "SPLIT":
+            e = self.parse()
+            self.expect(",")
+            d = self._str_literal()
+            self.expect(")")
+            return E.Split(e, d)
         if name == "CONCAT_WS":
             sep = self._str_literal()
             args = []
@@ -873,6 +880,42 @@ class _StmtParser:
                 rplan, ralias = self._parse_relation_primary(scope)
                 scope.add_relation(ralias, rplan.schema.names)
                 plan = L.Join(plan, rplan, "cross", (), ())
+                continue
+            if self.peek(0).upper == "LATERAL" \
+                    and self.peek(1).upper == "VIEW":
+                # LATERAL VIEW [POS]EXPLODE(expr) viewAlias AS col[, pos]
+                # (reference: hive LATERAL VIEW -> Generate; the view
+                # alias itself is accepted and ignored — columns resolve
+                # unqualified like the rest of this parser)
+                self.next(); self.next()
+                fn = self.next().upper
+                if fn not in ("EXPLODE", "POSEXPLODE"):
+                    raise SQLParseError(
+                        f"LATERAL VIEW supports explode/posexplode, "
+                        f"got {fn}")
+                self.expect("(")
+                resolver = self._make_resolver(scope, None)
+                ep = self._ep(resolver)
+                arr = ep.parse()
+                self._sync(ep)
+                self.expect(")")
+                self.next()  # view alias (required by the grammar)
+                names = []
+                if self.accept("AS"):
+                    names.append(self.next().value)
+                    while self.accept(","):
+                        names.append(self.next().value)
+                if fn == "POSEXPLODE":
+                    pos_name = names[0] if len(names) > 1 else "pos"
+                    out_name = (names[1] if len(names) > 1
+                                else (names[0] if names else "col"))
+                else:
+                    pos_name = None
+                    out_name = names[0] if names else "col"
+                gen = E.Explode(arr, with_position=fn == "POSEXPLODE")
+                plan = L.Generate(gen, out_name, pos_name, plan)
+                scope.add_relation(
+                    None, ([pos_name] if pos_name else []) + [out_name])
                 continue
             how = self._peek_join_type()
             if how is None:
@@ -1224,6 +1267,9 @@ def _composed_functions() -> dict:
         "CURRENT_DATE": F.current_date,
         "HOUR": F.hour, "MINUTE": F.minute, "SECOND": F.second,
         "INITCAP": F.initcap, "REVERSE": F.reverse,
+        "ARRAY": F.array, "SIZE": F.size, "CARDINALITY": F.size,
+        "ELEMENT_AT": F.element_at, "ARRAY_CONTAINS": F.array_contains,
+        "EXPLODE": F.explode, "POSEXPLODE": F.posexplode,
     }
 
 

@@ -134,6 +134,49 @@ class DecimalType(FractionalType):
         return hash((DecimalType, self.precision, self.scale))
 
 
+@dataclass(frozen=True)
+class ArrayType(DataType):
+    """array<element>. Device layout: PADDED 2D values (capacity,
+    max_len) plus a hidden '<col>#len' int32 companion column — the
+    TPU-first answer to the reference's offsets-based UnsafeArrayData
+    (UnsafeArrayData.java): static shapes, and every row-level kernel
+    (gather joins, compaction, exchanges, sort permutations) handles the
+    pair as two ordinary columns with zero special cases. Cost: memory
+    is rows x max_len (document per-batch); elements are non-null
+    (element_at of a missing position is NULL, null ELEMENTS inside an
+    array are not represented yet)."""
+
+    element: DataType
+    np_dtype: Any = field(default=np.int64, compare=False, repr=False)
+
+    def __repr__(self) -> str:
+        return f"array<{self.element!r}>"
+
+    def __hash__(self) -> int:
+        return hash((ArrayType, self.element))
+
+
+LEN_SUFFIX = "#len"
+
+
+def array_len_col(name: str) -> str:
+    """Hidden companion column carrying per-row array lengths."""
+    return name + LEN_SUFFIX
+
+
+@dataclass(frozen=True)
+class StructType(DataType):
+    """struct<...>. Structs FLATTEN at ingest into dotted columns
+    ('s.f1', 's.f2' — reference peer: UnsafeRow nested struct access);
+    this marker type survives only in error messages and casts."""
+
+    names: Tuple[str, ...] = ()
+    np_dtype: Any = field(default=np.int64, compare=False, repr=False)
+
+    def __hash__(self) -> int:
+        return hash((StructType, self.names))
+
+
 # Singleton instances for convenience.
 BOOLEAN = BooleanType()
 INT8 = Int8Type()
