@@ -249,6 +249,15 @@ class DataFrame:
 
     groupby = groupBy
 
+    def rollup(self, *cols: ColumnOrName) -> "GroupedData":
+        """Hierarchical subtotals (reference: Dataset.rollup ->
+        ResolveGroupingAnalytics/ExpandExec)."""
+        return GroupedData(self, tuple(_c(c) for c in cols), "rollup")
+
+    def cube(self, *cols: ColumnOrName) -> "GroupedData":
+        """All subtotal combinations (reference: Dataset.cube)."""
+        return GroupedData(self, tuple(_c(c) for c in cols), "cube")
+
     def agg(self, *exprs: E.Expression) -> "DataFrame":
         return self.groupBy().agg(*exprs)
 
@@ -484,15 +493,28 @@ def _fmt(v, truncate: bool) -> str:
 
 
 class GroupedData:
-    """Result of groupBy (reference:
+    """Result of groupBy/rollup/cube (reference:
     sql/core/.../RelationalGroupedDataset.scala)."""
 
-    def __init__(self, df: DataFrame, keys: Tuple[E.Expression, ...]):
+    def __init__(self, df: DataFrame, keys: Tuple[E.Expression, ...],
+                 mode: str = "groupby"):
         self._df = df
         self._keys = keys
+        self._mode = mode
 
     def agg(self, *exprs: E.Expression) -> DataFrame:
         outs = tuple(self._keys) + tuple(exprs)
+        if self._mode != "groupby":
+            from spark_tpu.plan.grouping import (cube_sets,
+                                                 grouping_sets_aggregate,
+                                                 rollup_sets)
+
+            sets = (rollup_sets(len(self._keys))
+                    if self._mode == "rollup"
+                    else cube_sets(len(self._keys)))
+            plan, _ = grouping_sets_aggregate(
+                self._df._plan, self._keys, sets, outs)
+            return self._df._with(plan)
         return self._df._with(
             L.Aggregate(self._keys, outs, self._df._plan))
 

@@ -313,14 +313,13 @@ def to_arrow(batch: Batch) -> pa.Table:
             else:
                 values = pa.array(
                     flat, type=dtype_to_arrow_type(f.dtype.element))
-            arr = pa.ListArray.from_arrays(
-                pa.array(offsets, pa.int32()), values)
             if valid is not None and not valid.all():
-                # rebuild with a validity bitmap (from_arrays has no
-                # mask parameter that keeps offsets aligned)
                 arr = pa.ListArray.from_arrays(
                     pa.array(offsets, pa.int32()), values,
                     mask=pa.array(~valid))
+            else:
+                arr = pa.ListArray.from_arrays(
+                    pa.array(offsets, pa.int32()), values)
             columns.append(arr)
             names.append(f.name)
             continue

@@ -420,8 +420,11 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
                      if len(vt.dictionary or ()) else vt.data)
             eq = adata == vdata[:, None]
         else:
-            eq = tv.data == _cast_data(
-                vt.data, vt.dtype, tv.dtype.element)[:, None]
+            # compare in the COMMON type: casting the needle to the
+            # element type would truncate 10.5 -> 10 and falsely match
+            ct = T.common_type(tv.dtype.element, vt.dtype)
+            eq = (_cast_data(tv.data, tv.dtype.element, ct)
+                  == _cast_data(vt.data, vt.dtype, ct)[:, None])
         res = jnp.any(eq & alive, axis=1)
         validity = _and_validity(tv.validity, vt.validity)
         return TV(res, validity, T.BOOLEAN, None)

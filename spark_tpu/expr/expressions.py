@@ -532,6 +532,36 @@ class Explode(Expression):
             + f"({self.child})"
 
 
+@dataclass(eq=False, frozen=True)
+class Grouping(Expression):
+    """grouping(col): 1 when the row is a subtotal that aggregated
+    ``col`` away (reference: grouping.scala Grouping). A marker —
+    ResolveGroupingAnalytics-style rewriting (plan/grouping.py) replaces
+    it with arithmetic over the grouping id before evaluation."""
+
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def __str__(self):
+        return f"grouping({self.child})"
+
+
+@dataclass(eq=False, frozen=True)
+class GroupingId(Expression):
+    """grouping_id() marker (reference: grouping.scala GroupingID)."""
+
+    def data_type(self, schema):
+        return T.INT64
+
+    def __str__(self):
+        return "grouping_id()"
+
+
 def contains_generator(e: Expression) -> bool:
     if isinstance(e, Explode):
         return True

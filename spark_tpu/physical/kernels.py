@@ -58,6 +58,14 @@ def lexsort_permutation(keys: Sequence[SortKey], row_mask: jnp.ndarray) -> jnp.n
     perm = jnp.arange(n)
     for key in reversed(list(keys)):
         d = key.data[perm]
+        if key.validity is not None:
+            # canonicalize NULL rows' payload BEFORE the data sort:
+            # sorting by garbage-under-null would scramble the
+            # less-significant key order established by earlier passes
+            # (all nulls are equal; their relative order must be
+            # whatever the previous keys made it)
+            v = key.validity[perm]
+            d = jnp.where(v, d, jnp.zeros((), d.dtype))
         idx = jnp.argsort(d, stable=True, descending=not key.ascending)
         perm = perm[idx]
         if key.validity is not None:

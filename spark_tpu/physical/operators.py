@@ -384,6 +384,71 @@ class LimitExec(PhysicalPlan):
 
 
 @dataclass(eq=False)
+class ExpandExec(PhysicalPlan):
+    """One output block per projection, stacked (reference:
+    execution/ExpandExec.scala:1): capacity = child capacity x G,
+    statically shaped — no sizing sync, fuses with the aggregation
+    above it (the ROLLUP/CUBE path is one XLA program end to end)."""
+
+    projections: Tuple[Tuple[E.Expression, ...], ...]
+    names: Tuple[str, ...]
+    child: PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        cached = self.__dict__.get("_schema_memo")
+        if cached is None:
+            from spark_tpu.plan import logical as L
+
+            cached = L.Expand(self.projections, self.names,
+                              _SchemaOnly(self.child.schema)).schema
+            self.__dict__["_schema_memo"] = cached
+        return cached
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        env = pipe.env()
+        n = pipe.capacity
+        out_schema = self.schema
+        cols: Dict[str, TV] = {}
+        for i, name in enumerate(self.names):
+            out_f = out_schema.fields[i]
+            tvs = [C.evaluate(proj[i], env) for proj in self.projections]
+            if isinstance(out_f.dtype, T.StringType):
+                union, tables = C.unify_dictionaries(
+                    tuple(tv.dictionary or () for tv in tvs))
+                datas = [(jnp.asarray(tb)[tv.data]
+                          if len(tv.dictionary or ()) else tv.data)
+                         for tv, tb in zip(tvs, tables)]
+                dictionary: Optional[Tuple[str, ...]] = union
+            else:
+                datas = [C._cast_data(tv.data, tv.dtype, out_f.dtype)
+                         for tv in tvs]
+                dictionary = None
+            data = jnp.concatenate(datas)
+            validity = None
+            if any(tv.validity is not None for tv in tvs):
+                validity = jnp.concatenate(
+                    [tv.valid_or_true(n) for tv in tvs])
+            cols[name] = TV(data, validity, out_f.dtype, dictionary)
+        mask = jnp.concatenate([pipe.mask] * len(self.projections))
+        return Pipe(cols, mask, list(self.names))
+
+    def node_string(self):
+        return f"Expand[{len(self.projections)} sets]"
+
+    def plan_key(self):
+        return ("Expand",
+                tuple(tuple(E.expr_key(e) for e in p)
+                      for p in self.projections),
+                self.names, self.child.plan_key())
+
+
+@dataclass(eq=False)
 class GenerateExec(PhysicalPlan):
     """Sized row expansion for explode/posexplode (reference:
     execution/GenerateExec.scala:1): one output row per live array
@@ -408,10 +473,15 @@ class GenerateExec(PhysicalPlan):
 
     @property
     def schema(self) -> Schema:
-        from spark_tpu.plan import logical as L
+        cached = self.__dict__.get("_schema_memo")
+        if cached is None:
+            from spark_tpu.plan import logical as L
 
-        return L.Generate(self.generator, self.out_name, self.pos_name,
-                          _SchemaOnly(self.child.schema)).schema
+            cached = L.Generate(self.generator, self.out_name,
+                                self.pos_name,
+                                _SchemaOnly(self.child.schema)).schema
+            self.__dict__["_schema_memo"] = cached
+        return cached
 
     def _expand(self, pipe: Pipe, cap: int, tv=None) -> Pipe:
         if tv is None:

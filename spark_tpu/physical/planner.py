@@ -68,6 +68,9 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
     if isinstance(plan, L.Generate):
         return P.GenerateExec(plan.generator, plan.out_name,
                               plan.pos_name, plan_physical(plan.child))
+    if isinstance(plan, L.Expand):
+        return P.ExpandExec(plan.projections, plan.names,
+                            plan_physical(plan.child))
     if isinstance(plan, L.Join):
         return P.JoinExec(plan_physical(plan.left), plan_physical(plan.right),
                           plan.how, plan.left_keys, plan.right_keys,

@@ -279,6 +279,43 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass(eq=False, frozen=True)
+class Expand(LogicalPlan):
+    """Replicate the input once per projection (reference:
+    plans/logical Expand + execution/ExpandExec.scala:1 — the engine
+    under ROLLUP/CUBE/GROUPING SETS). Output capacity is child capacity
+    x len(projections), statically shaped, fully traceable."""
+
+    projections: Tuple[Tuple[E.Expression, ...], ...]
+    names: Tuple[str, ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    @cached_property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = []
+        for i, name in enumerate(self.names):
+            dt = self.projections[0][i].data_type(cs)
+            nullable = False
+            dictionary = None
+            for proj in self.projections:
+                e = proj[i]
+                dt = T.common_type(dt, e.data_type(cs))
+                nullable = nullable or e.nullable(cs)
+                inner = E.strip_alias(e)
+                if isinstance(inner, E.Col) and inner.col_name in cs:
+                    dictionary = dictionary or cs.field(
+                        inner.col_name).dictionary
+            fields.append(Field(name, dt, nullable, dictionary))
+        return Schema(tuple(fields))
+
+    def node_string(self):
+        return f"Expand[{len(self.projections)} sets]"
+
+
+@dataclass(eq=False, frozen=True)
 class Generate(LogicalPlan):
     """One output row per generated element, child columns replicated
     (reference: plans/logical Generate + execution/GenerateExec.scala:1;

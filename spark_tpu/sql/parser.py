@@ -899,7 +899,9 @@ class _StmtParser:
                 arr = ep.parse()
                 self._sync(ep)
                 self.expect(")")
-                self.next()  # view alias (required by the grammar)
+                if self.peek().kind in ("id", "qid") \
+                        and self.peek().upper != "AS":
+                    self.next()  # optional view alias
                 names = []
                 if self.accept("AS"):
                     names.append(self.next().value)
@@ -1132,16 +1134,64 @@ class _StmtParser:
 
         # GROUP BY / HAVING / aggregate detection
         group_exprs: List[E.Expression] = []
+        gsets = None  # (keys, index sets) for ROLLUP/CUBE/GROUPING SETS
         if self.at_keyword("GROUP"):
             self.next()
             self.expect("BY")
-            while True:
-                ep = self._ep(self._group_resolver(resolver, select_exprs))
-                e = ep.parse()
-                self._sync(ep)
-                group_exprs.append(E.strip_alias(e))
-                if not self.accept(","):
-                    break
+            gresolver = self._group_resolver(resolver, select_exprs)
+
+            def parse_key_list():
+                keys = []
+                self.expect("(")
+                if not self.accept(")"):
+                    while True:
+                        ep = self._ep(gresolver)
+                        keys.append(E.strip_alias(ep.parse()))
+                        self._sync(ep)
+                        if self.accept(")"):
+                            break
+                        self.expect(",")
+                return keys
+
+            head = self.peek(0).upper
+            if head in ("ROLLUP", "CUBE") and self.peek(1).value == "(":
+                from spark_tpu.plan.grouping import cube_sets, rollup_sets
+
+                self.next()
+                keys = parse_key_list()
+                sets = (rollup_sets(len(keys)) if head == "ROLLUP"
+                        else cube_sets(len(keys)))
+                gsets = (keys, sets)
+            elif head == "GROUPING" and self.peek(1).upper == "SETS":
+                self.next()
+                self.next()
+                self.expect("(")
+                raw_sets = []
+                while True:
+                    raw_sets.append(tuple(parse_key_list()))
+                    if self.accept(")"):
+                        break
+                    self.expect(",")
+                # keys = ordered union across sets; sets -> index tuples
+                keys = []
+                seen_keys = {}
+                for s in raw_sets:
+                    for e in s:
+                        sk = E.expr_key(e)
+                        if sk not in seen_keys:
+                            seen_keys[sk] = len(keys)
+                            keys.append(e)
+                sets = [tuple(seen_keys[E.expr_key(e)] for e in s)
+                        for s in raw_sets]
+                gsets = (keys, sets)
+            else:
+                while True:
+                    ep = self._ep(gresolver)
+                    e = ep.parse()
+                    self._sync(ep)
+                    group_exprs.append(E.strip_alias(e))
+                    if not self.accept(","):
+                        break
         having = None
         if self.at_keyword("HAVING"):
             self.next()
@@ -1151,32 +1201,40 @@ class _StmtParser:
 
         has_agg = any(E.contains_aggregate(e) for e in select_exprs)
         has_window = any(E.contains_window(e) for e in select_exprs)
-        if has_window and (group_exprs or has_agg or having is not None):
+        if has_window and (group_exprs or gsets or has_agg
+                           or having is not None):
             raise NotImplementedError(
                 "window functions combined with GROUP BY/HAVING in the "
                 "same SELECT are not supported yet")
-        if group_exprs or has_agg or having is not None:
+        if gsets is not None:
+            from spark_tpu.plan.grouping import (contains_grouping_fns,
+                                                 grouping_sets_aggregate,
+                                                 rewrite_grouping_fns)
+
+            keys, sets = gsets
             outputs = list(select_exprs)
             having_cond = None
             if having is not None:
-                # pull aggregate calls out of the predicate as hidden
-                # outputs so HAVING becomes an ordinary Filter above the
-                # Aggregate (where subquery rewriting can reach it);
-                # project the hidden columns away afterwards
-                hidden: List[E.Alias] = []
-                seen_aggs: Dict[tuple, str] = {}
-
-                def pull(e: E.Expression) -> E.Expression:
-                    if isinstance(e, E.AggregateExpression):
-                        sk = E.expr_key(e)
-                        if sk not in seen_aggs:
-                            name = f"__h{len(hidden)}"
-                            seen_aggs[sk] = name
-                            hidden.append(E.Alias(e, name))
-                        return E.Col(seen_aggs[sk])
-                    return e
-
-                having_cond = E.transform_expr(having, pull)
+                hidden, having_cond = self._pull_having_aggs(having)
+                outputs = outputs + hidden
+                if contains_grouping_fns(having_cond):
+                    # HAVING reads the grouping id through a hidden
+                    # output; key references resolve against the
+                    # aggregate's ordinary output names
+                    outputs.append(E.Alias(E.GroupingId(), "__gidh"))
+                    having_cond = rewrite_grouping_fns(
+                        having_cond, keys, "__gidh")
+            plan, _ = grouping_sets_aggregate(
+                plan, keys, sets, tuple(outputs))
+            if having_cond is not None:
+                plan = L.Filter(having_cond, plan)
+                plan = L.Project(
+                    tuple(E.Col(e.name) for e in select_exprs), plan)
+        elif group_exprs or has_agg or having is not None:
+            outputs = list(select_exprs)
+            having_cond = None
+            if having is not None:
+                hidden, having_cond = self._pull_having_aggs(having)
                 outputs = outputs + hidden
             plan = L.Aggregate(tuple(group_exprs), tuple(outputs), plan)
             if having_cond is not None:
@@ -1189,6 +1247,26 @@ class _StmtParser:
         if distinct:
             plan = L.Distinct(plan)
         return plan
+
+    def _pull_having_aggs(self, having: E.Expression):
+        """Pull aggregate calls out of a HAVING predicate as hidden
+        outputs so it becomes an ordinary Filter above the Aggregate
+        (where subquery rewriting can reach it); the hidden columns are
+        projected away afterwards."""
+        hidden: List[E.Alias] = []
+        seen_aggs: Dict[tuple, str] = {}
+
+        def pull(e: E.Expression) -> E.Expression:
+            if isinstance(e, E.AggregateExpression):
+                sk = E.expr_key(e)
+                if sk not in seen_aggs:
+                    name = f"__h{len(hidden)}"
+                    seen_aggs[sk] = name
+                    hidden.append(E.Alias(e, name))
+                return E.Col(seen_aggs[sk])
+            return e
+
+        return hidden, E.transform_expr(having, pull)
 
     def _group_resolver(self, resolver: Resolver,
                         select_exprs: List[E.Expression]) -> Resolver:
@@ -1267,6 +1345,8 @@ def _composed_functions() -> dict:
         "CURRENT_DATE": F.current_date,
         "HOUR": F.hour, "MINUTE": F.minute, "SECOND": F.second,
         "INITCAP": F.initcap, "REVERSE": F.reverse,
+        "GROUPING": lambda c: E.Grouping(c),
+        "GROUPING_ID": lambda: E.GroupingId(),
         "ARRAY": F.array, "SIZE": F.size, "CARDINALITY": F.size,
         "ELEMENT_AT": F.element_at, "ARRAY_CONTAINS": F.array_contains,
         "EXPLODE": F.explode, "POSEXPLODE": F.posexplode,

@@ -184,3 +184,16 @@ def test_make_array_nullable_inputs_rejected(spark):
     df = spark.createDataFrame(tbl)
     with pytest.raises(NotImplementedError, match="null elements"):
         df.select(F.array(F.col("x"), F.lit(1)).alias("a")).collect()
+
+
+def test_array_contains_float_needle_no_truncate(arr_df):
+    rows = arr_df.select(
+        F.array_contains("xs", F.lit(10.5)).alias("c")).collect()
+    assert [r["c"] for r in rows] == [False, False, False, False]
+
+
+def test_lateral_view_without_view_alias(arr_df, spark):
+    rows = spark.sql(
+        "select id, t from arrs lateral view explode(tags) as t "
+        "where t = 'c'").collect()
+    assert [(r["id"], r["t"]) for r in rows] == [(2, "c")]

@@ -1,0 +1,121 @@
+"""ROLLUP / CUBE / GROUPING SETS via the Expand analogue (reference:
+Analyzer.scala ResolveGroupingAnalytics + execution/ExpandExec.scala:1
++ grouping.scala). sqlite has no grouping sets, so the oracle here is
+hand-computed UNION-of-aggregates over the same rows."""
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu.api import functions as F
+
+ROWS = [("x", "p", 1), ("x", "q", 2), ("y", "p", 4), ("y", "p", 8),
+        ("x", "p", 16)]
+
+
+@pytest.fixture(scope="module")
+def gdf(spark):
+    tbl = pa.table({
+        "a": pa.array([r[0] for r in ROWS]),
+        "b": pa.array([r[1] for r in ROWS]),
+        "v": pa.array([r[2] for r in ROWS], pa.int64()),
+    })
+    df = spark.createDataFrame(tbl)
+    df.createOrReplaceTempView("g")
+    return df
+
+
+def _key(t):
+    return tuple((x is None, str(x)) for x in t)
+
+
+def _norm(rows):
+    return sorted((tuple(r.values()) for r in
+                   (x.asDict() for x in rows)), key=_key)
+
+
+def test_rollup_sql(gdf, spark):
+    got = _norm(spark.sql(
+        "select a, b, sum(v) as s from g group by rollup(a, b)").collect())
+    want = sorted([
+        ("x", "p", 17), ("x", "q", 2), ("y", "p", 12),   # (a, b)
+        ("x", None, 19), ("y", None, 12),                # (a)
+        (None, None, 31),                                # ()
+    ], key=_key)
+    assert got == want
+
+
+def test_cube_sql(gdf, spark):
+    got = _norm(spark.sql(
+        "select a, b, sum(v) as s from g group by cube(a, b)").collect())
+    # cube adds the (b)-only subtotals on top of rollup's sets
+    assert (None, "p", 29) in got and (None, "q", 2) in got
+    assert ("x", None, 19) in got and (None, None, 31) in got
+    assert len(got) == 3 + 2 + 2 + 1
+
+
+def test_grouping_sets_sql(gdf, spark):
+    got = _norm(spark.sql(
+        "select a, b, sum(v) as s from g "
+        "group by grouping sets ((a, b), (b), ())").collect())
+    assert ("x", "p", 17) in got
+    assert (None, "p", 29) in got and (None, "q", 2) in got
+    assert (None, None, 31) in got
+    assert len(got) == 3 + 2 + 1
+
+
+def test_grouping_and_grouping_id(gdf, spark):
+    rows = spark.sql(
+        "select a, grouping(a) as ga, grouping(b) as gb, "
+        "grouping_id() as gid, sum(v) as s from g "
+        "group by rollup(a, b)").collect()
+    for r in rows:
+        d = r.asDict()
+        assert d["gid"] == d["ga"] * 2 + d["gb"]
+        if d["a"] is None:
+            assert d["ga"] == 1
+
+
+def test_having_over_rollup(gdf, spark):
+    got = _norm(spark.sql(
+        "select a, b, sum(v) as s from g group by rollup(a, b) "
+        "having sum(v) > 15").collect())
+    assert got == sorted([("x", "p", 17), ("x", None, 19),
+                          (None, None, 31)], key=_key)
+
+
+def test_dataframe_rollup_cube(gdf):
+    r = gdf.rollup("a").agg(F.sum("v").alias("s")).collect()
+    got = {(x["a"], x["s"]) for x in r}
+    assert got == {(None, 31), ("x", 19), ("y", 12)}
+    c = gdf.cube("a", "b").agg(F.count("v").alias("c")).collect()
+    assert len(c) == 3 + 2 + 2 + 1
+
+
+def test_subtotal_null_vs_real_null(spark):
+    """A REAL null key value must stay distinct from subtotal nulls
+    (the grouping id disambiguates — reference Expand semantics)."""
+    tbl = pa.table({
+        "a": pa.array(["x", None, "x"]),
+        "v": pa.array([1, 2, 4], pa.int64()),
+    })
+    spark.createDataFrame(tbl).createOrReplaceTempView("gn")
+    rows = spark.sql(
+        "select a, grouping(a) as ga, sum(v) as s from gn "
+        "group by rollup(a)").collect()
+    got = {(r["a"], r["ga"], r["s"]) for r in rows}
+    # real-null group (ga=0) and the grand total (ga=1) both present
+    assert ("x", 0, 5) in got
+    assert (None, 0, 2) in got
+    assert (None, 1, 7) in got
+
+
+def test_having_key_and_grouping_refs(gdf, spark):
+    got = _norm(spark.sql(
+        "select a, b, sum(v) as s from g group by rollup(a, b) "
+        "having a = 'x'").collect())
+    assert got == sorted([("x", "p", 17), ("x", "q", 2), ("x", None, 19)],
+                         key=_key)
+    got2 = _norm(spark.sql(
+        "select a, sum(v) as s from g group by rollup(a) "
+        "having grouping(a) = 1").collect())
+    assert got2 == [(None, 31)]
