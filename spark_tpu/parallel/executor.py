@@ -213,6 +213,26 @@ class MeshExecutor:
                                       self.plan(plan.right), plan.how,
                                       plan.left_keys, plan.right_keys,
                                       plan.condition)
+        if isinstance(plan, L.Window):
+            # hash-exchange on the partition keys so every partition
+            # lives whole on one device, then the ordinary local window
+            # operator (reference: WindowExec.scala:87
+            # requiredChildDistribution = ClusteredDistribution;
+            # EnsureRequirements inserts the same shuffle)
+            from spark_tpu.physical.window import WindowExec
+
+            child = self.plan(plan.child)
+            parts = [E.strip_alias(e).partition_by
+                     for e in plan.window_exprs]
+            keysets = {tuple(E.expr_key(k) for k in p) for p in parts}
+            if len(keysets) != 1:
+                raise NotImplementedError(
+                    "distributed windows need one shared PARTITION BY "
+                    "across the SELECT's window expressions")
+            keys = parts[0]
+            ex = (D.HashPartitionExchangeExec(keys, child) if keys
+                  else D.SinglePartitionExchangeExec(child))
+            return WindowExec(plan.window_exprs, ex)
         raise NotImplementedError(
             f"no distributed plan for {type(plan).__name__}")
 

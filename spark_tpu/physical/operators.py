@@ -18,6 +18,7 @@ HashedRelation.scala, limit.scala) — re-architected for XLA:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -398,16 +399,12 @@ class ExpandExec(PhysicalPlan):
     def children(self):
         return (self.child,)
 
-    @property
+    @functools.cached_property
     def schema(self) -> Schema:
-        cached = self.__dict__.get("_schema_memo")
-        if cached is None:
-            from spark_tpu.plan import logical as L
+        from spark_tpu.plan import logical as L
 
-            cached = L.Expand(self.projections, self.names,
-                              _SchemaOnly(self.child.schema)).schema
-            self.__dict__["_schema_memo"] = cached
-        return cached
+        return L.Expand(self.projections, self.names,
+                        _SchemaOnly(self.child.schema)).schema
 
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
         pipe = child_pipes[0]
@@ -471,17 +468,12 @@ class GenerateExec(PhysicalPlan):
     def traceable(self) -> bool:  # type: ignore[override]
         return self.adaptive is not None
 
-    @property
+    @functools.cached_property
     def schema(self) -> Schema:
-        cached = self.__dict__.get("_schema_memo")
-        if cached is None:
-            from spark_tpu.plan import logical as L
+        from spark_tpu.plan import logical as L
 
-            cached = L.Generate(self.generator, self.out_name,
-                                self.pos_name,
-                                _SchemaOnly(self.child.schema)).schema
-            self.__dict__["_schema_memo"] = cached
-        return cached
+        return L.Generate(self.generator, self.out_name, self.pos_name,
+                          _SchemaOnly(self.child.schema)).schema
 
     def _expand(self, pipe: Pipe, cap: int, tv=None) -> Pipe:
         if tv is None:

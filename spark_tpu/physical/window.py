@@ -218,7 +218,7 @@ class WindowExec(P.PhysicalPlan):
         raise NotImplementedError(f"window function {fn}")
 
     def _frame_bounds(self, w: E.WindowExpr, pos, seg_start, seg_end,
-                      peer_last):
+                      peer_last, env=None, perm=None, cap=None):
         """Per-row inclusive [lo, hi] frame positions in sorted space."""
         frame = w.frame
         if frame is None:
@@ -233,17 +233,74 @@ class WindowExec(P.PhysicalPlan):
                 seg_start, pos + start)
             hi = seg_end if end is None else jnp.minimum(seg_end, pos + end)
             return lo, hi
-        # range mode: only the unbounded/current-row shapes are supported
+        # range mode: unbounded / current-row shapes need no key values
         lo = seg_start if start is None else None
         hi = peer_last if (end == 0) else (seg_end if end is None else None)
-        if lo is None or hi is None:
+        if lo is not None and hi is not None:
+            return lo, hi
+        # value offsets: per-row bounded binary search over the ORDER
+        # key within each partition's sorted run (reference:
+        # window/WindowExec.scala RangeBoundOrdering / BoundOrdering —
+        # two searchsorteds per row, segment-bounded)
+        if len(w.order_by) != 1:
             raise NotImplementedError(
-                "RANGE frames with value offsets are not supported")
+                "RANGE frames with value offsets require exactly one "
+                "ORDER BY key (the reference has the same restriction)")
+        so = w.order_by[0]
+        tv = C.evaluate(so.child, env)
+        if isinstance(tv.dtype, T.StringType):
+            raise NotImplementedError(
+                "RANGE value offsets need a numeric/date ORDER key")
+        key = tv.data[perm].astype(jnp.float64)
+        scale = (10 ** tv.dtype.scale
+                 if isinstance(tv.dtype, T.DecimalType) else 1)
+        if not so.ascending:
+            key = -key  # DESC: PRECEDING means larger values
+        if tv.validity is not None:
+            # null keys are mutual peers; an infinity sentinel keeps
+            # them matching (only) each other under +/- offsets. Its
+            # SIGN must agree with where the sort PLACED the nulls in
+            # the partition run (nulls-first -> below every effective
+            # key; nulls-last -> above), or the run is non-monotone and
+            # the binary search returns garbage bounds.
+            sval = tv.validity[perm]
+            sent = -jnp.inf if so.nulls_first_resolved else jnp.inf
+            key = jnp.where(sval, key, sent)
+        if lo is None:
+            lo = self._bounded_search(
+                key, key + float(start) * scale, seg_start, seg_end,
+                cap, side="left")
+        if hi is None:
+            hi = self._bounded_search(
+                key, key + float(end) * scale, seg_start, seg_end,
+                cap, side="right") - 1
         return lo, hi
+
+    @staticmethod
+    def _bounded_search(sorted_key, targets, seg_start, seg_end, cap,
+                        side: str):
+        """Vectorized per-row binary search of targets[i] inside the
+        row's own partition run [seg_start[i], seg_end[i]] (the global
+        array is only sorted WITHIN partitions). ~log2(cap) gather
+        rounds, fully traced."""
+        import math as _math
+
+        lo = seg_start
+        hi = seg_end + 1  # exclusive
+        for _ in range(max(1, _math.ceil(_math.log2(max(2, cap)))) + 1):
+            mid = (lo + hi) // 2
+            mv = sorted_key[jnp.clip(mid, 0, cap - 1)]
+            go_right = (mv < targets) if side == "left" else \
+                (mv <= targets)
+            within = mid < hi
+            lo = jnp.where(within & go_right, mid + 1, lo)
+            hi = jnp.where(within & ~go_right, mid, hi)
+        return lo
 
     def _framed_agg(self, w, fn, env, perm, live, pos, seg, seg_start,
                     seg_end, peer_last, cap, cs):
-        lo, hi = self._frame_bounds(w, pos, seg_start, seg_end, peer_last)
+        lo, hi = self._frame_bounds(w, pos, seg_start, seg_end, peer_last,
+                                    env=env, perm=perm, cap=cap)
         child = fn.child if getattr(fn, "child", None) is not None else None
         if child is not None:
             tv = C.evaluate(child, env)

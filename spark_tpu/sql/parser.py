@@ -1168,7 +1168,13 @@ class _StmtParser:
                 self.expect("(")
                 raw_sets = []
                 while True:
-                    raw_sets.append(tuple(parse_key_list()))
+                    if self.peek().value == "(":
+                        raw_sets.append(tuple(parse_key_list()))
+                    else:
+                        # bare key = singleton set: GROUPING SETS (a, ())
+                        ep = self._ep(gresolver)
+                        raw_sets.append((E.strip_alias(ep.parse()),))
+                        self._sync(ep)
                     if self.accept(")"):
                         break
                     self.expect(",")

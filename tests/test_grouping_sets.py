@@ -119,3 +119,10 @@ def test_having_key_and_grouping_refs(gdf, spark):
         "select a, sum(v) as s from g group by rollup(a) "
         "having grouping(a) = 1").collect())
     assert got2 == [(None, 31)]
+
+
+def test_grouping_sets_bare_key(gdf, spark):
+    got = _norm(spark.sql(
+        "select a, sum(v) as s from g "
+        "group by grouping sets (a, ())").collect())
+    assert got == sorted([("x", 19), ("y", 12), (None, 31)], key=_key)
