@@ -224,11 +224,15 @@ class MeshExecutor:
             child = self.plan(plan.child)
             parts = [E.strip_alias(e).partition_by
                      for e in plan.window_exprs]
-            keysets = {tuple(E.expr_key(k) for k in p) for p in parts}
+            # exchanging on the key SET co-locates partitions for every
+            # spec that uses the same keys in any order (the local
+            # operator re-groups per spec anyway)
+            keysets = {frozenset(E.expr_key(k) for k in p)
+                       for p in parts}
             if len(keysets) != 1:
                 raise NotImplementedError(
                     "distributed windows need one shared PARTITION BY "
-                    "across the SELECT's window expressions")
+                    "key set across the SELECT's window expressions")
             keys = parts[0]
             ex = (D.HashPartitionExchangeExec(keys, child) if keys
                   else D.SinglePartitionExchangeExec(child))

@@ -251,9 +251,28 @@ class WindowExec(P.PhysicalPlan):
         if isinstance(tv.dtype, T.StringType):
             raise NotImplementedError(
                 "RANGE value offsets need a numeric/date ORDER key")
-        key = tv.data[perm].astype(jnp.float64)
         scale = (10 ** tv.dtype.scale
                  if isinstance(tv.dtype, T.DecimalType) else 1)
+        key = tv.data[perm]
+        integral = jnp.issubdtype(key.dtype, jnp.integer)
+        if integral:
+            # stay in the key's EXACT integer dtype: a float64 cast
+            # loses distinct int64/decimal keys above 2^53 and corrupts
+            # frame bounds silently
+            key = key.astype(jnp.int64)
+            off_lo = None if start is None else int(round(start * scale))
+            off_hi = None if end is None else int(round(end * scale))
+            neg_inf = jnp.iinfo(jnp.int64).min
+            pos_inf = jnp.iinfo(jnp.int64).max
+        else:
+            key = key.astype(jnp.float64)
+            off_lo = None if start is None else float(start) * scale
+            off_hi = None if end is None else float(end) * scale
+            neg_inf = -jnp.inf
+            pos_inf = jnp.inf
+            # NaN compares false on both sides of a binary search —
+            # map it to +inf (NaN sorts greatest, its peers likewise)
+            key = jnp.where(jnp.isnan(key), jnp.inf, key)
         if not so.ascending:
             key = -key  # DESC: PRECEDING means larger values
         if tv.validity is not None:
@@ -264,16 +283,22 @@ class WindowExec(P.PhysicalPlan):
             # key; nulls-last -> above), or the run is non-monotone and
             # the binary search returns garbage bounds.
             sval = tv.validity[perm]
-            sent = -jnp.inf if so.nulls_first_resolved else jnp.inf
+            sent = neg_inf if so.nulls_first_resolved else pos_inf
             key = jnp.where(sval, key, sent)
+        def target(off):
+            # sentinel rows keep their sentinel target (int64 sentinel
+            # +/- offset would WRAP and break null-peer matching)
+            return jnp.where((key == neg_inf) | (key == pos_inf), key,
+                             key + off)
+
         if lo is None:
             lo = self._bounded_search(
-                key, key + float(start) * scale, seg_start, seg_end,
-                cap, side="left")
+                key, target(off_lo), seg_start, seg_end, cap,
+                side="left")
         if hi is None:
             hi = self._bounded_search(
-                key, key + float(end) * scale, seg_start, seg_end,
-                cap, side="right") - 1
+                key, target(off_hi), seg_start, seg_end, cap,
+                side="right") - 1
         return lo, hi
 
     @staticmethod

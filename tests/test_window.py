@@ -222,3 +222,21 @@ def test_range_value_frames_with_nulls(spark):
     want = sorted((tuple(r) for r in conn.execute(sql).fetchall()),
                   key=key)
     assert got == want
+
+
+def test_mesh_window_partition_key_order_insensitive(spark):
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+    from spark_tpu.sql.parser import parse_sql
+
+    rows = [{"a": i % 2, "b": i % 3, "v": i} for i in range(12)]
+    spark.createDataFrame(rows).createOrReplaceTempView("mw")
+    sql = ("select v, rank() over (partition by a, b order by v) as r1, "
+           "sum(v) over (partition by b, a order by v) as s "
+           "from mw")
+    plan = parse_sql(sql, spark.catalog)
+    got = sorted(tuple(d.values()) for d in
+                 MeshExecutor(make_mesh(8)).execute_logical(plan).to_pylist())
+    want = sorted(tuple(r.asDict().values())
+                  for r in spark.sql(sql).collect())
+    assert got == want
