@@ -47,6 +47,29 @@ BROADCAST_THRESHOLD = register(
     "Max estimated build-side bytes for broadcast hash join "
     "(reference: SQLConf AUTO_BROADCASTJOIN_THRESHOLD).", int)
 
+SKEW_FACTOR = register(
+    "spark.tpu.skewJoin.factor", 5,
+    "A distributed join whose hottest device counts more than this "
+    "many times the median device's pairs after the hash exchange is "
+    "re-planned as a broadcast join over the balanced pre-exchange "
+    "distribution (reference: adaptive/OptimizeSkewedJoin.scala:37 "
+    "SKEW_JOIN_SKEWED_PARTITION_FACTOR; under SPMD static shapes one "
+    "hot device would size EVERY device's pair capacity).", int)
+
+SKEW_MIN_PAIRS = register(
+    "spark.tpu.skewJoin.minPairs", 1 << 16,
+    "Absolute floor for skew demotion: the hottest device must exceed "
+    "this many pairs (the factor alone misfires when most devices have "
+    "ZERO pairs, e.g. fewer distinct keys than devices — reference "
+    "pairs its factor with SKEW_JOIN_SKEWED_PARTITION_THRESHOLD for "
+    "the same reason).", int)
+
+SKEW_MAX_BROADCAST_BYTES = register(
+    "spark.tpu.skewJoin.maxBroadcastBytes", 256 * 1024 * 1024,
+    "Skew demotion replicates the build side onto every device; skip "
+    "it when the build side exceeds this (skew stays slow rather than "
+    "risking HBM exhaustion).", int)
+
 CASE_SENSITIVE = register(
     "spark.sql.caseSensitive", False,
     "Whether identifiers are case sensitive (reference: SQLConf.scala).", bool)

@@ -419,6 +419,7 @@ class MeshExecutor:
         union_dicts = self._union_dicts(lproj.schema, rproj.schema)
         mins, ranges = self._key_stats(lproj, rproj, union_dicts)
 
+        left0, right0 = left_sb, right_sb  # pre-exchange (balanced rows)
         if not broadcast:
             left_sb = self.run(D.HashPartitionExchangeExec(
                 jb.left_keys, D.ShardScanExec(left_sb),
@@ -427,17 +428,49 @@ class MeshExecutor:
                 jb.right_keys, D.ShardScanExec(right_sb),
                 key_union_dicts=union_dicts))
 
+        def count_pairs(ls, rs, bcast):
+            cnt_plan = D.JoinCountExec(
+                D.ShardScanExec(ls), D.ShardScanExec(rs),
+                jb.left_keys, jb.right_keys, mins, ranges, bcast)
+            cnt_sb = self._run_stage(cnt_plan)
+            return np.asarray(cnt_sb.data.columns[0].data)
+
         need_count = not (how in ("left_semi", "left_anti")
                           and jb.condition is None and mins is not None)
         pair_cap = 0
         if need_count:
-            cnt_plan = D.JoinCountExec(
-                D.ShardScanExec(left_sb), D.ShardScanExec(right_sb),
-                jb.left_keys, jb.right_keys, mins, ranges, broadcast)
-            cnt_sb = self._run_stage(cnt_plan)
-            counts = np.asarray(cnt_sb.data.columns[0].data)
+            counts = count_pairs(left_sb, right_sb, broadcast)
+            # AQE skew handling (reference: OptimizeSkewedJoin.scala:37
+            # splits oversized partitions; DynamicJoinSelection demotes
+            # to broadcast). Hash exchange sends every row of one hot
+            # key to ONE device, so its pair count — and, under SPMD
+            # static shapes, EVERY device's capacity — blows up. The
+            # pre-exchange distribution is row-sliced and balanced, so
+            # re-running as a broadcast join bounds per-device pairs at
+            # ~total/d: pairs ride with the evenly-spread probe rows.
+            from spark_tpu import conf as _conf
+
+            factor = self.conf.get(_conf.SKEW_FACTOR)
+            min_pairs = self.conf.get(_conf.SKEW_MIN_PAIRS)
+            med = float(np.median(counts)) if counts.size else 0.0
+            skewed = (not broadcast and counts.size
+                      and int(counts.max()) >= min_pairs
+                      and float(counts.max()) > factor * max(1.0, med))
+            if skewed and how in ("inner", "left", "left_semi",
+                                  "left_anti") \
+                    and _estimated_bytes(right0) <= self.conf.get(
+                        _conf.SKEW_MAX_BROADCAST_BYTES):
+                from spark_tpu import metrics
+
+                metrics.record(
+                    "skew_join_broadcast", max=int(counts.max()),
+                    median=med, factor=factor)
+                broadcast = True
+                left_sb, right_sb = left0, right0
+                counts = count_pairs(left_sb, right_sb, True)
             pair_cap = K.bucket(int(counts.max()) if counts.size else 0)
 
+        left0 = right0 = None  # release pre-exchange device buffers
         apply_plan = D.JoinApplyExec(
             D.ShardScanExec(left_sb), D.ShardScanExec(right_sb), how,
             jb.left_keys, jb.right_keys, jb.condition, mins, ranges,
