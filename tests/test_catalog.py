@@ -1,9 +1,12 @@
 """Persistent catalog: saveAsTable + warehouse-backed lookup (reference:
 SessionCatalog.scala:61 external tier, DataFrameWriter.saveAsTable)."""
 
+import pytest
+
 from spark_tpu.api import functions as F
 
 
+@pytest.mark.slow
 def test_save_as_table_roundtrip(spark, tmp_path):
     spark.conf.set("spark.sql.warehouse.dir", str(tmp_path / "wh"))
     try:

@@ -117,6 +117,7 @@ def test_direct_group_agg_psum(ex, rel):
         assert got[k][1] == want[k][1]
 
 
+@pytest.mark.slow
 def test_shuffle_group_agg(ex, rel):
     """int keys -> hash exchange + sort-agg path."""
     plan = L.Aggregate(
@@ -355,7 +356,7 @@ def test_skew_join_rebalances_to_broadcast(spark):
     from spark_tpu.sql.parser import parse_sql
 
     rng = np.random.default_rng(17)
-    n = 120_000  # hot-device pairs must clear spark.tpu.skewJoin.minPairs
+    n = 40_000
     hot = rng.random(n) < 0.9
     keys = np.where(hot, 7, rng.integers(0, 1000, n))
     spark.createDataFrame(pa.table({
@@ -376,6 +377,7 @@ def test_skew_join_rebalances_to_broadcast(spark):
     plan = parse_sql(sql, spark.catalog)
     ex = MeshExecutor(make_mesh(8))
     ex.conf.set(_conf.BROADCAST_THRESHOLD.key, 1)
+    ex.conf.set(_conf.SKEW_MIN_PAIRS.key, 5000)
     from spark_tpu.parallel import operators as D
 
     apply_caps = []

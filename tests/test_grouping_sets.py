@@ -33,6 +33,7 @@ def _norm(rows):
                    (x.asDict() for x in rows)), key=_key)
 
 
+@pytest.mark.slow
 def test_rollup_sql(gdf, spark):
     got = _norm(spark.sql(
         "select a, b, sum(v) as s from g group by rollup(a, b)").collect())
@@ -44,6 +45,7 @@ def test_rollup_sql(gdf, spark):
     assert got == want
 
 
+@pytest.mark.slow
 def test_cube_sql(gdf, spark):
     got = _norm(spark.sql(
         "select a, b, sum(v) as s from g group by cube(a, b)").collect())

@@ -150,7 +150,8 @@ def test_runtime_filter_semi_join_reduction(spark, tmp_path):
     from spark_tpu.plan import logical as L
     from spark_tpu.plan.optimizer import optimize
 
-    n = 1 << 18  # >= spark.tpu.runtimeFilter.minRows
+    n = 1 << 15
+    spark.conf.set("spark.tpu.runtimeFilter.minRows", n)
     rng = np.random.default_rng(5)
     pq.write_table(pa.table({
         "k": pa.array(rng.integers(0, 1000, n), pa.int64()),
@@ -176,5 +177,6 @@ def test_runtime_filter_semi_join_reduction(spark, tmp_path):
         got = df.collect()[0]
     finally:
         spark.conf.unset("spark.tpu.runtimeFilter.semiJoinReduction")
+        spark.conf.unset("spark.tpu.runtimeFilter.minRows")
     assert got["c"] == want["c"]
     assert abs(got["s"] - want["s"]) < 1e-9 * max(1.0, abs(want["s"]))

@@ -25,6 +25,7 @@ def big_parquet(spark, tmp_path):
     return path, tbl
 
 
+@pytest.mark.slow
 def test_chunked_aggregation_matches_materialized(spark, big_parquet):
     path, tbl = big_parquet
     df = spark.read.parquet(path)

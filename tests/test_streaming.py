@@ -119,6 +119,7 @@ def test_watermark_append_mode_evicts_closed_windows(spark):
     assert got == {(0, 2), (10, 1), (20, 1)}
 
 
+@pytest.mark.slow
 def test_streaming_on_mesh(spark):
     """The same incremental machinery runs on the distributed engine."""
     from spark_tpu.parallel.executor import MeshExecutor

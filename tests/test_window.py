@@ -224,6 +224,7 @@ def test_range_value_frames_with_nulls(spark):
     assert got == want
 
 
+@pytest.mark.slow
 def test_mesh_window_partition_key_order_insensitive(spark):
     from spark_tpu.parallel.executor import MeshExecutor
     from spark_tpu.parallel.mesh import make_mesh
