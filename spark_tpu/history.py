@@ -1,0 +1,163 @@
+"""History reader: render JSONL event logs for a human.
+
+The reference serves event logs through a Jetty web UI + History Server
+(reference: core/src/main/scala/org/apache/spark/ui/SparkUI.scala:40,
+deploy/history/FsHistoryProvider.scala:1, status/AppStatusStore.scala).
+A single-process TPU driver does not need a web stack to make its
+history legible — this module folds the JSONL event stream
+(metrics.py, written under ``spark.eventLog.dir``) into per-query and
+per-stage rollups and renders them as text (CLI) or a single static
+HTML file.
+
+Usage::
+
+    python -m spark_tpu.history <event-log-dir-or-file> [--html out.html]
+
+or programmatically: ``history.summarize(path)`` -> list of query
+dicts; ``spark_tpu.tracing.query_profile()`` remains the live
+in-process view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def _iter_events(path: str):
+    files = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith((".jsonl", ".log", ".json")):
+                files.append(os.path.join(path, name))
+    else:
+        files = [path]
+    for f in files:
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line of a live log
+
+
+def summarize(path: str) -> List[Dict[str, Any]]:
+    """Fold the event stream into queries: each ``query_start`` mark
+    opens a bucket; stage events accumulate wall time per operator."""
+    queries: List[Dict[str, Any]] = []
+    current: Optional[Dict[str, Any]] = None
+
+    def close():
+        nonlocal current
+        if current is not None:
+            queries.append(current)
+            current = None
+
+    for ev in _iter_events(path):
+        kind = ev.get("kind", "")
+        if kind == "query_start":
+            close()
+            current = {"label": str(ev.get("description", "?")),
+                       "ts": ev.get("ts"), "stages": [],
+                       "events": 0, "total_ms": 0.0}
+            continue
+        if current is None:
+            current = {"label": "(before first query mark)", "ts": None,
+                       "stages": [], "events": 0, "total_ms": 0.0}
+        current["events"] += 1
+        ms = float(ev.get("ms", 0.0) or 0.0)
+        current["total_ms"] += ms
+        if kind == "stage":
+            current["stages"].append({
+                "kind": str(ev.get("op", "stage")),
+                "node": str(ev.get("node", ""))[:100],
+                "ms": ms,
+                "cap_in": ev.get("cap_in"),
+                "error": ev.get("error"),
+            })
+        elif kind in ("stage_compile", "chunked_agg", "runtime_filter",
+                      "skew_join_broadcast", "stage_retry") \
+                or (kind == "heartbeat" and not ev.get("ok", True)):
+            current["stages"].append({
+                "kind": kind if kind != "heartbeat" else "heartbeat_fail",
+                "node": json.dumps({k: v for k, v in ev.items()
+                                    if k not in ("kind", "ts")})[:100],
+                "ms": ms,
+                "error": ev.get("error"),
+            })
+    close()
+    return queries
+
+
+def render_text(queries: List[Dict[str, Any]], top: int = 8) -> str:
+    out = []
+    out.append(f"{'query':<44} {'stages':>6} {'total ms':>10}")
+    out.append("-" * 64)
+    for q in queries:
+        out.append(f"{q['label'][:44]:<44} {len(q['stages']):>6} "
+                   f"{q['total_ms']:>10.1f}")
+        for st in sorted(q["stages"], key=lambda s: -s["ms"])[:top]:
+            err = (f"  ERROR: {st['error']}"
+                   if st.get("error") else "")
+            out.append(f"    {st['ms']:>9.1f} ms  {st['kind']:<19} "
+                       f"{st['node']}{err}")
+    return "\n".join(out)
+
+
+def render_html(queries: List[Dict[str, Any]]) -> str:
+    """One static page: per-query bars + stage tables (the SQL-tab
+    DAG view collapsed to what matters: where the time went)."""
+    from html import escape
+
+    maxms = max((q["total_ms"] for q in queries), default=1.0) or 1.0
+    rows = []
+    for i, q in enumerate(queries):
+        w = int(100 * q["total_ms"] / maxms)
+        stage_rows = "".join(
+            f"<tr><td>{st['ms']:.1f}</td><td>{escape(st['kind'])}</td>"
+            f"<td><code>{escape(st['node'])}"
+            + (f" <b>ERROR: {escape(str(st['error']))}</b>"
+               if st.get("error") else "")
+            + "</code></td></tr>"
+            for st in sorted(q["stages"], key=lambda s: -s["ms"]))
+        rows.append(
+            f"<details><summary><b>{escape(q['label'])}</b> — "
+            f"{q['total_ms']:.1f} ms, {len(q['stages'])} stages "
+            f"<span style='display:inline-block;background:#4a90d9;"
+            f"height:10px;width:{w}%'></span></summary>"
+            f"<table border=1 cellpadding=3><tr><th>ms</th><th>kind"
+            f"</th><th>stage</th></tr>{stage_rows}</table></details>")
+    return ("<html><head><meta charset='utf-8'><title>spark_tpu history"
+            "</title></head><body><h2>spark_tpu event-log history</h2>"
+            + "".join(rows) + "</body></html>")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Render a spark_tpu JSONL event log "
+                    "(spark.eventLog.dir) as text or HTML.")
+    ap.add_argument("path", help="event-log file or directory")
+    ap.add_argument("--html", metavar="OUT",
+                    help="write a static HTML report instead of text")
+    args = ap.parse_args(argv)
+    queries = summarize(args.path)
+    if not queries:
+        print("no events found")
+        return 1
+    if args.html:
+        with open(args.html, "w") as f:
+            f.write(render_html(queries))
+        print(f"wrote {args.html} ({len(queries)} queries)")
+    else:
+        print(render_text(queries))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,38 @@
+"""History reader over the JSONL event log (reference role:
+ui/SparkUI.scala:40 + deploy/history/FsHistoryProvider.scala — here a
+web-stack-free text/HTML renderer, spark_tpu/history.py)."""
+
+import subprocess
+import sys
+
+
+def test_history_summarize_and_render(spark, tmp_path):
+    from spark_tpu import history
+
+    logdir = tmp_path / "events"
+    logdir.mkdir()
+    spark.conf.set("spark.eventLog.dir", str(logdir))
+    try:
+        df = spark.createDataFrame(
+            [{"k": i % 3, "v": float(i)} for i in range(64)])
+        df.groupBy("k").sum("v").collect()
+        df.filter("v > 10").count()
+    finally:
+        spark.conf.unset("spark.eventLog.dir")
+
+    queries = history.summarize(str(logdir))
+    assert len(queries) >= 2
+    assert any(q["stages"] for q in queries)
+    text = history.render_text(queries)
+    assert "total ms" in text and "ms" in text
+    html = history.render_html(queries)
+    assert html.startswith("<html>") and "details" in html
+
+    out = tmp_path / "report.html"
+    rc = subprocess.run(
+        [sys.executable, "-m", "spark_tpu.history", str(logdir),
+         "--html", str(out)],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert rc.returncode == 0, rc.stderr
+    assert out.exists() and out.read_text().startswith("<html>")
