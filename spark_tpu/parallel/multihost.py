@@ -55,6 +55,25 @@ def initialize(coordinator: Optional[str] = None,
         raise e
 
 
+def barrier_kv_exchange(key: str, value: str, peer_key: str,
+                        timeout_s: int = 30) -> str:
+    """Cross-process rendezvous through the coordination service's
+    key-value store: publish ``key``=``value``, block until ``peer_key``
+    appears, return the peer's value. This is the driver<->executor
+    registration handshake shape (reference:
+    CoarseGrainedSchedulerBackend RegisterExecutor/RegisteredExecutor)
+    carried by the SAME control plane every production barrier uses —
+    and the thing a two-process test can assert REALLY crosses process
+    boundaries."""
+    from jax._src import distributed as _dist
+
+    client = _dist.global_state.client
+    if client is None:
+        raise RuntimeError("multihost.initialize() has not run")
+    client.key_value_set(key, value)
+    return client.blocking_key_value_get(peer_key, timeout_s * 1000)
+
+
 def global_mesh(devices: Optional[Sequence] = None):
     """A data mesh over EVERY device in the job (all hosts). Shardings
     placed on this mesh make XLA route intra-host traffic over ICI and
