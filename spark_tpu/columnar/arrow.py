@@ -153,10 +153,25 @@ def _column_to_numpy(
             # low limb of each 16-byte little-endian value (values fit
             # int64 at the engine's p<=18 cap, so the high limb is pure
             # sign extension)
-            assert arr.type.scale == dtype.scale
             raw = np.frombuffer(arr.buffers()[1], dtype=np.int64)
             lo = arr.offset * 2
             values = raw[lo:lo + 2 * len(arr):2].copy()
+            delta = dtype.scale - arr.type.scale
+            if delta > 0:
+                limit = (10 ** 18 - 1) // (10 ** delta)
+                if len(values) and np.abs(values).max() > limit:
+                    raise NotImplementedError(
+                        f"rescaling decimal({arr.type.precision},"
+                        f"{arr.type.scale}) storage to scale "
+                        f"{dtype.scale} overflows the engine's 18-digit "
+                        "int64 cap — narrow the schema scale or cast to "
+                        "double")
+                values = values * (10 ** delta)
+            elif delta < 0:
+                # HALF_UP, matching every other ->decimal path
+                factor = 10 ** (-delta)
+                values = (np.sign(values)
+                          * ((np.abs(values) + factor // 2) // factor))
             if validity is not None:
                 values = np.where(validity, values, 0)
             return values, validity, None
@@ -310,6 +325,11 @@ def to_arrow(batch: Batch) -> pa.Table:
                 values = pa.DictionaryArray.from_arrays(
                     pa.array(flat.astype(np.int32), pa.int32()),
                     pa.array(d, pa.string())).cast(pa.string())
+            elif isinstance(f.dtype.element, T.DecimalType):
+                # flat holds UNSCALED scaled-int64 values — route through
+                # the raw-buffer rebuild like the scalar decimal branch
+                values = decimal_from_unscaled(
+                    flat, dtype_to_arrow_type(f.dtype.element))
             else:
                 values = pa.array(
                     flat, type=dtype_to_arrow_type(f.dtype.element))

@@ -450,8 +450,22 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             return TV(res, tv.validity, T.BOOLEAN, None)
         res = jnp.zeros((n,), dtype=jnp.bool_)
         for v in expr.values:
+            if v is None:
+                continue  # NULL list element never equals (engine-wide
+                # two-valued IN; non-matching rows stay false, not null)
             if isinstance(tv.dtype, T.DateType) and isinstance(v, datetime.date):
                 v = T.date_to_days(v)
+            if isinstance(tv.dtype, T.DecimalType):
+                # device data is the SCALED int64: scale the literal the
+                # same way _literal_tv does. A literal that does not land
+                # on the scale grid (0.0501 vs scale 2) can never equal a
+                # stored value — skip it rather than round to a false hit.
+                import decimal as _dec
+
+                q = _dec.Decimal(str(v)).scaleb(tv.dtype.scale)
+                if q != q.to_integral_value():
+                    continue
+                v = int(q)
             res = res | (tv.data == v)
         return TV(res, tv.validity, T.BOOLEAN, None)
 

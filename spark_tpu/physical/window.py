@@ -137,6 +137,12 @@ class WindowExec(P.PhysicalPlan):
                      for tv, _ in order_tvs]
             ochange = jnp.zeros((cap,), jnp.bool_)
             for data, validity in okeys:
+                if jnp.issubdtype(data.dtype, jnp.floating):
+                    # NaN != NaN would split each NaN row into its own
+                    # peer group; all NaNs are mutual peers (they sort
+                    # together, greatest) — canonicalize before comparing
+                    data = jnp.where(jnp.isnan(data),
+                                     jnp.finfo(data.dtype).max, data)
                 neq = jnp.concatenate(
                     [jnp.ones((1,), jnp.bool_), data[1:] != data[:-1]])
                 if validity is not None:
@@ -255,6 +261,7 @@ class WindowExec(P.PhysicalPlan):
                  if isinstance(tv.dtype, T.DecimalType) else 1)
         key = tv.data[perm]
         integral = jnp.issubdtype(key.dtype, jnp.integer)
+        nan_mask = None
         if integral:
             # stay in the key's EXACT integer dtype: a float64 cast
             # loses distinct int64/decimal keys above 2^53 and corrupts
@@ -270,9 +277,14 @@ class WindowExec(P.PhysicalPlan):
             off_hi = None if end is None else float(end) * scale
             neg_inf = -jnp.inf
             pos_inf = jnp.inf
-            # NaN compares false on both sides of a binary search —
-            # map it to +inf (NaN sorts greatest, its peers likewise)
-            key = jnp.where(jnp.isnan(key), jnp.inf, key)
+            # NaN compares false on both sides of a binary search. It
+            # sorts greatest but is a DISTINCT peer group from NULLs, so
+            # map it to the largest FINITE float: the +/-inf null
+            # sentinel then stays strictly beyond it under both sort
+            # directions (desc negates this to -finfo.max, still inside
+            # the -inf nulls-first sentinel).
+            nan_mask = jnp.isnan(key)
+            key = jnp.where(nan_mask, jnp.finfo(jnp.float64).max, key)
         if not so.ascending:
             key = -key  # DESC: PRECEDING means larger values
         if tv.validity is not None:
@@ -287,9 +299,12 @@ class WindowExec(P.PhysicalPlan):
             key = jnp.where(sval, key, sent)
         def target(off):
             # sentinel rows keep their sentinel target (int64 sentinel
-            # +/- offset would WRAP and break null-peer matching)
-            return jnp.where((key == neg_inf) | (key == pos_inf), key,
-                             key + off)
+            # +/- offset would WRAP and break null-peer matching; a NaN
+            # row's frame is exactly its NaN peers — NaN+off is NaN)
+            fixed = (key == neg_inf) | (key == pos_inf)
+            if nan_mask is not None:
+                fixed = fixed | nan_mask
+            return jnp.where(fixed, key, key + off)
 
         if lo is None:
             lo = self._bounded_search(

@@ -241,3 +241,68 @@ def test_mesh_window_partition_key_order_insensitive(spark):
     want = sorted(tuple(r.asDict().values())
                   for r in spark.sql(sql).collect())
     assert got == want
+
+
+def test_range_frame_nan_and_null_distinct_peers(spark):
+    """NaN sorts greatest but is a DISTINCT peer group from NULLs
+    (regression: both mapped to +inf under nulls-last, becoming mutual
+    frame peers). Checked by hand: the NaN row's unbounded-RANGE frame
+    must not include the NULL row's value and vice versa."""
+    import math
+
+    import pyarrow as pa
+
+    tbl = pa.table({
+        "k": pa.array([1, 1, 1, 1], pa.int64()),
+        "o": pa.array([1.0, 2.0, math.nan, None], pa.float64()),
+        "v": pa.array([10, 20, 300, 4000], pa.int64()),
+    })
+    spark.createDataFrame(tbl).createOrReplaceTempView("nanwin")
+    # default frame = RANGE UNBOUNDED PRECEDING..CURRENT ROW incl peers.
+    # asc nulls-last order: 1.0, 2.0, NaN, NULL
+    rows = spark.sql(
+        "select o, sum(v) over (partition by k order by o asc nulls last"
+        ") as s from nanwin").collect()
+    by_val = {("nan" if isinstance(r["o"], float) and math.isnan(r["o"])
+               else r["o"]): r["s"] for r in rows}
+    assert by_val[1.0] == 10
+    assert by_val[2.0] == 30
+    assert by_val["nan"] == 330      # NOT 4330: NULL row is not a peer
+    assert by_val[None] == 4330
+    # explicit value-offset frame around each row: NaN and NULL rows
+    # see only their own peer groups
+    rows2 = spark.sql(
+        "select o, sum(v) over (partition by k order by o asc nulls last"
+        " range between 1 preceding and 1 following) as s "
+        "from nanwin").collect()
+    by2 = {("nan" if isinstance(r["o"], float) and math.isnan(r["o"])
+            else r["o"]): r["s"] for r in rows2}
+    assert by2["nan"] == 300 and by2[None] == 4000
+    # desc nulls-first: NULL, NaN, 2.0, 1.0 — same distinctness
+    rows3 = spark.sql(
+        "select o, sum(v) over (partition by k order by o desc "
+        "nulls first) as s from nanwin").collect()
+    by3 = {("nan" if isinstance(r["o"], float) and math.isnan(r["o"])
+            else r["o"]): r["s"] for r in rows3}
+    assert by3[None] == 4000 and by3["nan"] == 4300
+
+
+def test_multiple_nans_are_mutual_peers(spark):
+    """Two NaN ORDER keys are ONE peer group (regression: NaN != NaN
+    split each NaN row into its own group in the running-frame path)."""
+    import math
+
+    import pyarrow as pa
+
+    tbl = pa.table({
+        "k": pa.array([1, 1, 1], pa.int64()),
+        "o": pa.array([1.0, math.nan, math.nan], pa.float64()),
+        "v": pa.array([10, 100, 200], pa.int64()),
+    })
+    spark.createDataFrame(tbl).createOrReplaceTempView("nan2")
+    rows = spark.sql(
+        "select v, sum(v) over (order by o) as s, rank() over "
+        "(order by o) as r from nan2").collect()
+    by_v = {r["v"]: (r["s"], r["r"]) for r in rows}
+    assert by_v[10] == (10, 1)
+    assert by_v[100] == (310, 2) and by_v[200] == (310, 2)
