@@ -31,13 +31,15 @@ absolute ms as the record.
 
 import json
 import os
-import tempfile
+
 import time
 
 import numpy as np
 
 SF = float(os.environ.get("BENCH_SF", "1.0"))
-N_ITER = int(os.environ.get("BENCH_ITERS", "5"))
+# SF>10 runs out-of-HBM (host-streamed chunks): one timed pass, no
+# median protocol — a single q1 pass at SF100 is minutes of parquet IO
+N_ITER = int(os.environ.get("BENCH_ITERS", "5" if SF <= 10 else "1"))
 # BENCH_FULL=1: additionally time ALL 22 TPC-H queries (the BASELINE.md
 # target metric is the full suite; q1/q3/q5 stay the headline line)
 FULL = os.environ.get("BENCH_FULL", "0") == "1"
@@ -47,9 +49,21 @@ HBM_GBPS = 819.0  # v5e peak HBM bandwidth; v5p is higher, so safe bound
 BASELINE_MS = {1: 900.0, 3: 700.0, 5: 1100.0}
 
 
-def _query_bytes(plan) -> int:
-    """Bytes of live column data in the physical plan's scan leaves —
-    the minimum the query must touch; used for the bandwidth bound."""
+def _query_bytes(plan, conf) -> int:
+    """Bytes of live column data in the plan's scan leaves — the
+    minimum the query must touch; used for the bandwidth bound. When the
+    plan will execute out-of-HBM, the estimate comes from scan row
+    counts (physically planning it would materialize the big scans)."""
+    from spark_tpu.physical import chunked as CH
+    from spark_tpu.plan import logical as L
+
+    if CH.find_chunkable(plan, conf) is not None:
+        total = 0
+        for s in L.collect_nodes(plan, L.UnresolvedScan):
+            total += CH._est_scan(s) or 0
+        assert total, "no data leaves: benchmark would constant-fold"
+        return total
+
     from spark_tpu.physical import operators as P
     from spark_tpu.physical.planner import plan_physical
 
@@ -80,20 +94,16 @@ def main():
     from spark_tpu.plan.optimizer import optimize
     from spark_tpu.plan.subquery import rewrite_subqueries
     from spark_tpu.sql.parser import parse_sql
-    from spark_tpu.tpch.gen import generate_tables, write_parquet, \
-        register_views
+    from spark_tpu.tpch.gen import ensure_dataset, register_views
     from spark_tpu.tpch.queries import QUERIES
 
     platform = jax.devices()[0].platform
     spark = SparkSession.builder.getOrCreate()
 
     t0 = time.time()
-    tables = generate_tables(SF)
+    tmp = ensure_dataset(SF)  # generate-once disk cache
     gen_s = time.time() - t0
-    tmp = tempfile.mkdtemp(prefix="tpch_bench_")
     t0 = time.time()
-    write_parquet(tables, tmp)
-    del tables
     register_views(spark, path=tmp)
     io_s = time.time() - t0
 
@@ -101,34 +111,50 @@ def main():
     for qnum in (1, 3, 5):
         df = spark.sql(QUERIES[qnum])
         lp = optimize(rewrite_subqueries(df._plan))
-        nbytes = _query_bytes(lp)
+        nbytes = _query_bytes(lp, spark.conf)
 
-        t0 = time.time()
-        rows1 = df.collect()  # warm-up 1: compiles + parquet read + stats
-        rows = df.collect()  # warm-up 2: adaptive join stats now bound —
-        # PK-FK joins fuse into one XLA program; compiles it
-        warm_s = time.time() - t0
-        assert rows, f"q{qnum} returned no rows"
-        # cross-path parity: the first (blocking) execution and the
-        # adaptive traced replay must produce the same result set (the
-        # full vs-sqlite oracle parity runs in tests/test_tpch.py at a
-        # smaller SF; this guards the fast path at BENCH scale)
-        assert len(rows1) == len(rows), f"q{qnum}: traced row count differs"
-        for a, b in zip(rows1, rows):
-            a = a.asDict() if hasattr(a, "asDict") else a
-            b = b.asDict() if hasattr(b, "asDict") else b
-            for x, y in zip(a.values(), b.values()):
-                if isinstance(x, float):
-                    assert abs(x - y) <= 1e-6 * max(1.0, abs(x)), \
-                        f"q{qnum}: traced value drift {x} vs {y}"
-                else:
-                    assert x == y, f"q{qnum}: traced mismatch {x} vs {y}"
+        if SF <= 10:
+            t0 = time.time()
+            rows1 = df.collect()  # warm-up 1: compiles + read + stats
+            rows = df.collect()  # warm-up 2: adaptive join stats bound —
+            # PK-FK joins fuse into one XLA program; compiles it
+            warm_s = time.time() - t0
+            assert rows, f"q{qnum} returned no rows"
+            # cross-path parity: the first (blocking) execution and the
+            # adaptive traced replay must produce the same result set
+            # (the full vs-sqlite oracle parity runs in
+            # tests/test_tpch.py at a smaller SF; this guards the fast
+            # path at BENCH scale)
+            assert len(rows1) == len(rows), \
+                f"q{qnum}: traced row count differs"
+            for a, b in zip(rows1, rows):
+                a = a.asDict() if hasattr(a, "asDict") else a
+                b = b.asDict() if hasattr(b, "asDict") else b
+                for x, y in zip(a.values(), b.values()):
+                    if isinstance(x, float):
+                        assert abs(x - y) <= 1e-6 * max(1.0, abs(x)), \
+                            f"q{qnum}: traced value drift {x} vs {y}"
+                    else:
+                        assert x == y, \
+                            f"q{qnum}: traced mismatch {x} vs {y}"
 
-        times = []
-        for _ in range(N_ITER):
-            t0 = time.perf_counter()
-            rows = df.collect()
-            times.append((time.perf_counter() - t0) * 1000.0)
+            times = []
+            for _ in range(N_ITER):
+                t0 = time.perf_counter()
+                rows = df.collect()
+                times.append((time.perf_counter() - t0) * 1000.0)
+        else:
+            # out-of-HBM scale: every pass re-streams the dataset, so
+            # the first (and only, unless BENCH_ITERS>1) pass IS the
+            # honest number — compile time amortizes across hundreds of
+            # chunk dispatches inside it
+            warm_s = 0.0
+            times = []
+            for _ in range(N_ITER):
+                t0 = time.perf_counter()
+                rows = df.collect()
+                times.append((time.perf_counter() - t0) * 1000.0)
+            assert rows, f"q{qnum} returned no rows"
         ms = float(np.median(times))
         gbps = nbytes / (ms / 1e3) / 1e9
         assert gbps < HBM_GBPS, (

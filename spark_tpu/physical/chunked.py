@@ -1,23 +1,52 @@
-"""Out-of-HBM execution: chunked scan-aggregation.
+"""Out-of-HBM execution: chunked scans through aggregation, joins, and
+top-k, plus grace-hash partitioned joins when BOTH sides exceed HBM.
 
 A v5e chip holds ~16 GB of HBM; TPC-H SF100 lineitem alone is ~80 GB.
-When an aggregation's scan would exceed the device budget
-(spark.tpu.maxDeviceBatchBytes), the plan is NOT materialized: the
-parquet dataset streams through host RAM in bounded chunks, each chunk's
-PARTIAL aggregates run on device as an ordinary batch query, and
-partials merge through the same accumulator decomposition streaming uses
-(plan/incremental.AggSpec). Peak device footprint = one chunk + the
-running state, independent of input size.
+When a plan's scan would exceed the device budget
+(spark.tpu.maxDeviceBatchBytes), the plan is NOT materialized. Three
+tiers, all built on the same merge-state decomposition streaming uses
+(plan/incremental.AggSpec):
 
-Reference analogue: ExternalSorter.scala:93 spill-to-disk +
-TungstenAggregationIterator.scala:82 sort-merge fallback — except the
-reference spills mid-operator, while here the operator is re-planned as
-a merge over chunk partials (the map-side-combine shape of AggUtils).
+1. **Streamed aggregation** (`_ChunkedAgg`, sidecars=[]): the parquet
+   dataset streams through host RAM in bounded chunks; each chunk's
+   PARTIAL aggregates run on device; partials merge device-side.
+
+2. **Streamed join tree** (`_ChunkedAgg` with sidecars): one big scan
+   joined against sub-budget subplans. The small join inputs
+   ("sidecars") pre-materialize ONCE to device-resident Relations; big
+   chunks then flow through the ORIGINAL join tree per chunk. Sound
+   because each big-side row contributes to the join output
+   independently when the big side is on a preserved streamed side
+   (inner/cross either side; left/semi/anti left; right right) — the
+   union of per-chunk join outputs IS the join output. Join-key
+   membership filters from the sidecars are applied host-side to each
+   chunk before it is shipped (exact semi filter below
+   spark.tpu.semiFilterExactMax keys, Bloom above it — the runtime-
+   filter/Bloom pushdown of InjectRuntimeFilter.scala:36, done where it
+   actually pays: the host->device tunnel), and the key's min/max range
+   is pushed into the parquet scan for row-group pruning.
+
+3. **Grace-hash join** (`_GraceHashAgg`): both sides over budget. Both
+   scans hash-partition by join key into P host-RAM bucket sets (one
+   streaming pass each); each bucket pair then joins on device as an
+   ordinary sub-budget plan. Every key lands in exactly one bucket, so
+   inner/outer/semi semantics all hold bucket-locally.
+
+plus **streamed top-k** (`_ChunkedTopK`): Limit(Sort(big scan)) keeps a
+running device top-(n+offset) merged per chunk.
+
+Reference analogue: ExternalSorter.scala:93 spill-merge,
+SortMergeJoinExec.scala:39 + ShuffledHashJoinExec (grace hash is the
+spill-tier shape of its build), TungstenAggregationIterator.scala:82
+sort-merge fallback — except the reference spills mid-operator, while
+here the operator is re-planned as a merge over chunk partials (the
+map-side-combine shape of AggUtils).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import dataclasses
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +69,25 @@ CHUNK_ROWS = CF.register(
     "spark.tpu.chunkRows", 1 << 21,
     "Rows per device chunk for out-of-HBM execution.", int)
 
+SEMI_FILTER_EXACT_MAX = CF.register(
+    "spark.tpu.semiFilterExactMax", 64 << 20,
+    "Chunked joins filter big-side chunks host-side by join-key "
+    "membership in the materialized small side. Up to this many distinct "
+    "keys the filter is EXACT (sorted array + searchsorted); above it a "
+    "Bloom bitset is used instead (false positives only cost transfer). "
+    "0 disables the host-side filter.", int)
+
+GRACE_PARTITIONS_MAX = CF.register(
+    "spark.tpu.gracePartitionsMax", 256,
+    "Upper bound on grace-hash join partition count.", int)
+
+# join types through which a big LEFT / RIGHT child may stream
+_STREAM_LEFT = ("inner", "cross", "left", "left_semi", "left_anti")
+_STREAM_RIGHT = ("inner", "cross", "right")
+# join types where non-matching streamed rows can be DROPPED host-side
+_FILTER_LEFT = ("inner", "left_semi")
+_FILTER_RIGHT = ("inner",)
+
 
 def _schema_width(schema) -> int:
     """Bytes per row of the scan's (column-pruned) schema."""
@@ -56,111 +104,697 @@ def _schema_width(schema) -> int:
     return width
 
 
-def find_chunkable(plan: L.LogicalPlan, conf) -> Optional[tuple]:
-    """Detect `...unary ops...(Aggregate(... over one big UnresolvedScan))`
-    and return (above_chain, aggregate, scan) when the scan exceeds the
-    device budget. ``above_chain`` are the unary nodes above the
-    aggregate, outermost first."""
-    budget = conf.get(MAX_DEVICE_BATCH_BYTES)
+def _est_scan(scan: L.UnresolvedScan) -> Optional[int]:
+    try:
+        rows = scan.source.count_rows(scan.filters)
+    except Exception:
+        return None
+    return rows * _schema_width(scan.schema)
+
+
+def _contains(plan: L.LogicalPlan, target: L.LogicalPlan) -> bool:
+    if plan is target:
+        return True
+    return any(_contains(c, target) for c in plan.children())
+
+
+def _peel_above(plan: L.LogicalPlan):
     above: List[L.LogicalPlan] = []
     node = plan
     while isinstance(node, (L.Project, L.Sort, L.Limit, L.Filter)) \
             and not isinstance(node, L.Aggregate):
         above.append(node)
         node = node.children()[0]
-    if not isinstance(node, L.Aggregate):
+    return above, node
+
+
+@dataclasses.dataclass
+class _PathJoin:
+    join: L.Join
+    big_on_left: bool
+
+    @property
+    def sidecar(self) -> L.LogicalPlan:
+        return self.join.right if self.big_on_left else self.join.left
+
+    @property
+    def big_keys(self) -> Tuple[E.Expression, ...]:
+        return self.join.left_keys if self.big_on_left \
+            else self.join.right_keys
+
+    @property
+    def sidecar_keys(self) -> Tuple[E.Expression, ...]:
+        return self.join.right_keys if self.big_on_left \
+            else self.join.left_keys
+
+    @property
+    def can_filter(self) -> bool:
+        how = self.join.how
+        return how in (_FILTER_LEFT if self.big_on_left else _FILTER_RIGHT)
+
+
+def _stream_path(root: L.LogicalPlan,
+                 big: L.UnresolvedScan) -> Optional[List[_PathJoin]]:
+    """Validate that every node between ``root`` and the big scan is
+    either per-row (Filter/Project/SubqueryAlias) or a join the big side
+    may stream through; return the joins on the path (outermost first),
+    or None when the shape is inadmissible."""
+    out: List[_PathJoin] = []
+    node = root
+    while node is not big:
+        if isinstance(node, (L.Filter, L.Project, L.SubqueryAlias)):
+            node = node.children()[0]
+            continue
+        if isinstance(node, L.Join):
+            in_left = _contains(node.left, big)
+            in_right = _contains(node.right, big)
+            if in_left == in_right:  # both (self-join) or neither
+                return None
+            how = node.how
+            if in_left and how in _STREAM_LEFT:
+                out.append(_PathJoin(node, True))
+                node = node.left
+                continue
+            if in_right and how in _STREAM_RIGHT:
+                out.append(_PathJoin(node, False))
+                node = node.right
+                continue
+            return None
         return None
-    # the subtree below the aggregate must be PER-ROW only (Filter/
-    # Project/alias over the scan): anything order- or set-sensitive
-    # (Limit, Distinct, Window, Sample, Join, nested Aggregate) would be
-    # wrongly re-applied per chunk
-    def per_row_only(p: L.LogicalPlan) -> bool:
-        if isinstance(p, L.UnresolvedScan):
-            return True
-        if isinstance(p, (L.Filter, L.Project, L.SubqueryAlias)):
-            return per_row_only(p.children()[0])
-        return False
+    return out
 
-    if not per_row_only(node.child):
+
+def _resolve_to_scan_col(expr: E.Expression, root: L.LogicalPlan,
+                         big: L.UnresolvedScan) -> Optional[str]:
+    """Trace a join-key expression from ``root``'s output schema down
+    the streamed path to a direct column of the big scan (through
+    Project aliases and join-output renames); None when it is computed
+    or lands outside the scan."""
+    expr = E.strip_alias(expr)
+    node = root
+    while node is not big:
+        if not isinstance(expr, E.Col):
+            return None
+        name = expr.col_name
+        if isinstance(node, (L.Filter, L.SubqueryAlias)):
+            node = node.children()[0]
+            continue
+        if isinstance(node, L.Project):
+            for e in node.exprs:
+                if isinstance(e, E.Alias) and e.alias_name == name:
+                    expr = E.strip_alias(e.child)
+                    break
+                if isinstance(e, E.Col) and e.col_name == name:
+                    break
+            else:
+                return None
+            node = node.children()[0]
+            continue
+        if isinstance(node, L.Join):
+            big_on_left = _contains(node.left, big)
+            out_names = list(node.schema.names)
+            if name not in out_names:
+                return None
+            pos = out_names.index(name)
+            ln = list(node.left.schema.names)
+            if big_on_left:
+                if pos >= len(ln):
+                    return None
+                expr = E.Col(ln[pos])
+                node = node.left
+            else:
+                if pos < len(ln):
+                    return None
+                rn = list(node.right.schema.names)
+                expr = E.Col(rn[pos - len(ln)])
+                node = node.right
+            continue
         return None
-    try:
-        AggSpec(node.groupings, node.aggregates)
-    except NotImplementedError:
-        return None  # non-mergeable aggregate: execute directly
-    scans = L.collect_nodes(node.child, L.UnresolvedScan)
-    if len(scans) != 1:
-        return None
-    scan = scans[0]
-    try:
-        rows = scan.source.count_rows(scan.filters)
-    except Exception:
-        return None
-    est = rows * _schema_width(scan.schema)
-    if est <= budget:
-        return None
-    return above, node, scan
+    if isinstance(expr, E.Col) and expr.col_name in big.schema.names:
+        return expr.col_name
+    return None
 
 
-def execute_chunked(found: tuple, conf, run_fn) -> "object":
-    """Execute a chunkable plan (``found`` from find_chunkable);
-    ``run_fn(logical_plan) -> Batch`` is the engine (single-device or
-    mesh). Returns the final Batch."""
-    from spark_tpu import metrics
-    from spark_tpu.columnar.arrow import from_arrow
+class _MergeState:
+    """Running device-side merge of per-chunk partial batches: the state
+    stays a DEVICE batch across chunks (an arrow round trip would
+    download every chunk's partials through the host — catastrophic on a
+    tunneled TPU: ~77 s of fetches for SF10 q1)."""
 
-    above, agg, scan = found
-    spec = AggSpec(agg.groupings, agg.aggregates)
-    key_aliases = tuple(E.Alias(g, n) for g, n
-                        in zip(spec.groupings_exec, spec.key_names))
-    chunk_rows = conf.get(CHUNK_ROWS)
+    def __init__(self, merge_plan_fn, run_fn):
+        self._merge_plan_fn = merge_plan_fn  # (state_rel|None, partial_plan) -> plan
+        self._run = run_fn
+        self.batch = None
+        self.chunks = 0
 
-    # the running merge state stays a DEVICE batch across chunks: the
-    # old arrow round trip downloaded every chunk's partials through the
-    # host (catastrophic on a tunneled TPU — ~77 s of fetches for SF10
-    # q1) where a device-side Union+merge moves nothing until the end
-    state = None  # Batch
-    n_chunks = 0
-    for tbl in scan.source.iter_batches(scan.columns, scan.filters,
-                                        chunk_rows):
-        rel = L.Relation(from_arrow(tbl))
+    def feed(self, partial_plan: L.LogicalPlan) -> None:
+        from spark_tpu.physical.operators import stats_recording_disabled
 
-        def splice(p: L.LogicalPlan) -> L.LogicalPlan:
-            if isinstance(p, L.UnresolvedScan):
-                return rel
-            return p
-
-        batch_child = agg.child.transform_up(splice)
-        partial = L.Aggregate(tuple(spec.groupings_exec),
-                              key_aliases + tuple(spec.partials),
-                              batch_child)
-        keys = tuple(E.Col(n) for n in spec.key_names)
-        merge_outs = tuple(E.Alias(E.Col(n), n)
-                           for n in spec.key_names) + tuple(spec.merges)
-        if state is None:
-            merged = L.Aggregate(keys, merge_outs, partial)
-        else:
-            aligned = L.Project(
-                tuple(E.Col(n) for n in state.schema.names), partial)
-            merged = L.Aggregate(
-                keys, merge_outs, L.Union(L.Relation(state), aligned))
+        state_rel = None if self.batch is None else L.Relation(self.batch)
+        plan = self._merge_plan_fn(state_rel, partial_plan)
         # every chunk plan is single-shot (fresh leaf arrays): recording
         # adaptive/output stats would cost one blocking sync per chunk
         # and flood the LRU caches with dead entries
-        from spark_tpu.physical.operators import stats_recording_disabled
-
         with stats_recording_disabled():
-            state = run_fn(merged)
-        n_chunks += 1
-    metrics.record("chunked_agg", chunks=n_chunks,
-                   groups=0 if state is None else state.num_valid_rows())
+            self.batch = self._run(plan)
+        self.chunks += 1
 
-    if state is None:  # empty scan: run the aggregate directly
-        final0: L.LogicalPlan = agg
-        for node in reversed(above):
-            final0 = node.with_children((final0,))
-        return run_fn(final0)
-    final: L.LogicalPlan = L.Project(tuple(spec.outputs),
-                                     L.Relation(state))
-    for node in reversed(above):
-        final = node.with_children((final,))
-    return run_fn(final)
+
+def _int_key_values(batch, col: str) -> Optional[np.ndarray]:
+    """Join-key column of a device batch as host int64 values (valid
+    rows only); None for non-integral keys."""
+    from spark_tpu import types as T
+
+    try:
+        f = batch.schema.field(col)
+    except Exception:
+        return None
+    dt = f.dtype
+    if not (getattr(dt, "is_integral", False)
+            or isinstance(dt, (T.DateType, T.DecimalType))):
+        return None
+    cd = batch.column(col)
+    data = np.asarray(cd.data).astype(np.int64)
+    mask = np.asarray(batch.data.row_mask)
+    if cd.validity is not None:
+        mask = mask & np.asarray(cd.validity)
+    return data[mask]
+
+
+class _HostKeyFilter:
+    """Host-side membership filter over one big-side key column: exact
+    sorted-array semi filter up to ``semiFilterExactMax`` distinct keys,
+    Bloom bitset above (same mergeable hash family as sketch.py's device
+    Bloom; false positives only cost transfer). Also exposes the key
+    range for parquet row-group pruning."""
+
+    _MIX = np.uint64(0x9E3779B97F4A7C15)
+
+    def __init__(self, col: str, values: np.ndarray, exact_max: int):
+        self.col = col
+        uniq = np.unique(values)  # sorted
+        self.lo = int(uniq[0]) if len(uniq) else 0
+        self.hi = int(uniq[-1]) if len(uniq) else 0
+        self.exact = len(uniq) <= exact_max
+        if self.exact:
+            self._keys = uniq
+        else:
+            # ~16 bits/key, two probes -> <1% false positives
+            nbits = 1 << int(np.ceil(np.log2(max(len(uniq), 2) * 16)))
+            self._nbits = np.uint64(nbits)
+            words = np.zeros(nbits // 64, dtype=np.uint64)
+            for salt in (np.uint64(1), np.uint64(2)):
+                h = (uniq.astype(np.uint64) * self._MIX * salt) \
+                    % self._nbits
+                np.bitwise_or.at(words, (h // 64).astype(np.int64),
+                                 np.uint64(1) << (h % np.uint64(64)))
+            self._words = words
+
+    def member(self, vals: np.ndarray) -> np.ndarray:
+        vals = vals.astype(np.int64, copy=False)
+        if self.exact:
+            pos = np.searchsorted(self._keys, vals)
+            pos = np.clip(pos, 0, max(len(self._keys) - 1, 0))
+            return (self._keys[pos] == vals) if len(self._keys) \
+                else np.zeros(len(vals), dtype=bool)
+        ok = np.ones(len(vals), dtype=bool)
+        for salt in (np.uint64(1), np.uint64(2)):
+            h = (vals.astype(np.uint64) * self._MIX * salt) % self._nbits
+            bit = (self._words[(h // 64).astype(np.int64)]
+                   >> (h % np.uint64(64))) & np.uint64(1)
+            ok &= bit.astype(bool)
+        return ok
+
+    def range_conjuncts(self, schema) -> List[E.Expression]:
+        """min/max pushdown predicates for the parquet scan (row-group
+        pruning; exact filtering there would re-hash per row in C++ —
+        the membership test stays in numpy)."""
+        from spark_tpu import types as T
+
+        f = schema.field(self.col)
+        lo: object = self.lo
+        hi: object = self.hi
+        if isinstance(f.dtype, T.DecimalType):
+            return []  # literal would need descaling; range gain is nil
+        if isinstance(f.dtype, T.DateType):
+            lo = T.days_to_date(self.lo)
+            hi = T.days_to_date(self.hi)
+        return [E.Cmp(">=", E.Col(self.col), E.Literal(lo)),
+                E.Cmp("<=", E.Col(self.col), E.Literal(hi))]
+
+
+def _empty_rel(scan: L.UnresolvedScan) -> L.Relation:
+    from spark_tpu.columnar.arrow import from_arrow
+    from spark_tpu.io.datasource import _pa_schema_from_schema
+
+    return L.Relation(
+        from_arrow(_pa_schema_from_schema(scan.schema).empty_table()))
+
+
+def _splice(root: L.LogicalPlan, mapping: Dict[int, L.LogicalPlan]):
+    def repl(p: L.LogicalPlan) -> L.LogicalPlan:
+        return mapping.get(id(p), p)
+
+    return root.transform_up(repl)
+
+
+@dataclasses.dataclass
+class _ChunkedAgg:
+    """Tiers 1+2: Aggregate over per-row ops / streamable joins around
+    ONE over-budget scan."""
+
+    above: List[L.LogicalPlan]
+    agg: L.Aggregate
+    big: L.UnresolvedScan
+    path_joins: List[_PathJoin]
+
+    def execute(self, conf, run_fn):
+        from spark_tpu import metrics
+        from spark_tpu.columnar.arrow import from_arrow
+
+        agg, scan = self.agg, self.big
+        spec = AggSpec(agg.groupings, agg.aggregates)
+        key_aliases = tuple(E.Alias(g, n) for g, n
+                            in zip(spec.groupings_exec, spec.key_names))
+        chunk_rows = conf.get(CHUNK_ROWS)
+        exact_max = conf.get(SEMI_FILTER_EXACT_MAX)
+
+        # 1. materialize each sidecar ONCE; they stay device-resident
+        sidecar_rel: Dict[int, L.LogicalPlan] = {}
+        filters: List[_HostKeyFilter] = []
+        for pj in self.path_joins:
+            batch = run_fn(pj.sidecar)
+            sidecar_rel[id(pj.sidecar)] = L.Relation(batch)
+            if (exact_max > 0 and pj.can_filter
+                    and len(pj.big_keys) == 1):
+                col = _resolve_to_scan_col(
+                    pj.big_keys[0],
+                    pj.join.left if pj.big_on_left else pj.join.right,
+                    scan)
+                if col is None:
+                    continue
+                skey = E.strip_alias(pj.sidecar_keys[0])
+                try:
+                    kb = run_fn(L.Project(
+                        (E.Alias(skey, "__semi_k"),),
+                        L.Relation(batch)))
+                    vals = _int_key_values(kb, "__semi_k")
+                except Exception:
+                    vals = None
+                if vals is not None:
+                    filters.append(_HostKeyFilter(col, vals, exact_max))
+        skeleton = _splice(agg.child, sidecar_rel) \
+            if sidecar_rel else agg.child
+
+        # 2. push key ranges into the scan, stream + filter chunks
+        scan_filters = tuple(scan.filters)
+        scan_cols = scan.columns
+        for kf in filters:
+            try:
+                scan_filters = scan_filters \
+                    + tuple(kf.range_conjuncts(scan.schema))
+            except Exception:
+                pass
+        if filters and scan_cols is not None:
+            # membership columns must be in the streamed projection
+            need = [kf.col for kf in filters if kf.col not in scan_cols]
+            read_cols = tuple(scan_cols) + tuple(dict.fromkeys(need))
+        else:
+            read_cols = scan_cols
+
+        keys = tuple(E.Col(n) for n in spec.key_names)
+        merge_outs = tuple(E.Alias(E.Col(n), n)
+                           for n in spec.key_names) + tuple(spec.merges)
+
+        def merge_plan(state_rel, partial):
+            if state_rel is None:
+                return L.Aggregate(keys, merge_outs, partial)
+            aligned = L.Project(
+                tuple(E.Col(n) for n in state_rel.schema.names), partial)
+            return L.Aggregate(keys, merge_outs,
+                               L.Union(state_rel, aligned))
+
+        state = _MergeState(merge_plan, run_fn)
+        rows_in = rows_kept = 0
+        for tbl in scan.source.iter_batches(read_cols, scan_filters,
+                                            chunk_rows):
+            rows_in += tbl.num_rows
+            if filters:
+                keep = np.ones(tbl.num_rows, dtype=bool)
+                for kf in filters:
+                    col = tbl.column(kf.col)
+                    vals = _decode_key_np(col)
+                    if vals is None:
+                        continue
+                    keep &= kf.member(vals)
+                if not keep.all():
+                    tbl = tbl.filter(keep)
+                if scan_cols is not None \
+                        and len(read_cols) != len(scan_cols):
+                    tbl = tbl.select(list(scan_cols))
+            if tbl.num_rows == 0:
+                continue
+            rows_kept += tbl.num_rows
+            chunk_plan = _splice(skeleton,
+                                 {id(scan): L.Relation(from_arrow(tbl))})
+            partial = L.Aggregate(tuple(spec.groupings_exec),
+                                  key_aliases + tuple(spec.partials),
+                                  chunk_plan)
+            state.feed(partial)
+        metrics.record(
+            "chunked_agg", chunks=state.chunks,
+            sidecars=len(sidecar_rel), key_filters=len(filters),
+            rows_in=rows_in, rows_kept=rows_kept,
+            groups=0 if state.batch is None
+            else state.batch.num_valid_rows())
+
+        if state.batch is None:
+            # empty stream: run the aggregate over an EMPTY spliced
+            # relation — the original plan would rematerialize the scan
+            final0: L.LogicalPlan = L.Aggregate(
+                agg.groupings, agg.aggregates,
+                _splice(skeleton, {id(scan): _empty_rel(scan)}))
+            for node in reversed(self.above):
+                final0 = node.with_children((final0,))
+            return run_fn(final0)
+        final: L.LogicalPlan = L.Project(tuple(spec.outputs),
+                                         L.Relation(state.batch))
+        for node in reversed(self.above):
+            final = node.with_children((final,))
+        return run_fn(final)
+
+
+def _decode_key_np(col) -> Optional[np.ndarray]:
+    """Arrow (chunked) column -> int64 numpy for membership testing;
+    None when the storage isn't integral (dictionary/strings)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    t = col.type
+    if pa.types.is_dictionary(t):
+        return None
+    if pa.types.is_decimal(t):
+        raw = np.frombuffer(col.buffers()[1], dtype=np.int64)
+        lo = col.offset * 2
+        return raw[lo:lo + 2 * len(col):2].copy()
+    try:
+        if pa.types.is_date(t) or pa.types.is_timestamp(t):
+            col = col.cast(pa.int64())
+        vals = pc.fill_null(col, 0).to_numpy(zero_copy_only=False)
+        if not np.issubdtype(vals.dtype, np.integer):
+            return None
+        return vals.astype(np.int64, copy=False)
+    except Exception:
+        return None
+
+
+@dataclasses.dataclass
+class _GraceHashAgg:
+    """Tier 3: Aggregate over Join(per-row(bigA), per-row(bigB)) with
+    both scans over budget — grace-hash partitioning into host-RAM
+    buckets, then per-bucket device joins feeding the merge state."""
+
+    above: List[L.LogicalPlan]
+    agg: L.Aggregate
+    join: L.Join
+    scan_a: L.UnresolvedScan  # under join.left
+    scan_b: L.UnresolvedScan  # under join.right
+    key_a: str  # partition column on scan_a
+    key_b: str
+    est_total: int
+
+    _MIX = np.uint64(0x9E3779B97F4A7C15)
+
+    def execute(self, conf, run_fn):
+        from spark_tpu import metrics
+        from spark_tpu.columnar.arrow import from_arrow
+
+        budget = conf.get(MAX_DEVICE_BATCH_BYTES)
+        chunk_rows = conf.get(CHUNK_ROWS)
+        nparts = int(min(conf.get(GRACE_PARTITIONS_MAX),
+                         max(2, -(-4 * self.est_total // max(budget, 1)))))
+
+        def partition(scan, key_col):
+            buckets: List[list] = [[] for _ in range(nparts)]
+            for tbl in scan.source.iter_batches(
+                    scan.columns, scan.filters, chunk_rows):
+                vals = _decode_key_np(tbl.column(key_col))
+                if vals is None:
+                    raise NotImplementedError(
+                        "grace-hash join needs an integral partition key")
+                h = ((vals.astype(np.uint64) * self._MIX)
+                     >> np.uint64(32)) % np.uint64(nparts)
+                h = h.astype(np.int64)
+                for p in np.unique(h):
+                    buckets[p].append(tbl.filter(h == p))
+            return buckets
+
+        buckets_a = partition(self.scan_a, self.key_a)
+        buckets_b = partition(self.scan_b, self.key_b)
+
+        spec = AggSpec(self.agg.groupings, self.agg.aggregates)
+        key_aliases = tuple(E.Alias(g, n) for g, n
+                            in zip(spec.groupings_exec, spec.key_names))
+        keys = tuple(E.Col(n) for n in spec.key_names)
+        merge_outs = tuple(E.Alias(E.Col(n), n)
+                           for n in spec.key_names) + tuple(spec.merges)
+
+        def merge_plan(state_rel, partial):
+            if state_rel is None:
+                return L.Aggregate(keys, merge_outs, partial)
+            aligned = L.Project(
+                tuple(E.Col(n) for n in state_rel.schema.names), partial)
+            return L.Aggregate(keys, merge_outs,
+                               L.Union(state_rel, aligned))
+
+        state = _MergeState(merge_plan, run_fn)
+        import pyarrow as pa
+
+        def concat(parts, scan):
+            if not parts:
+                # typed empty table so the spliced Relation keeps schema
+                from spark_tpu.io.datasource import _pa_schema_from_schema
+
+                return _pa_schema_from_schema(scan.schema).empty_table()
+            return pa.concat_tables(parts)
+
+        outer = self.join.how in ("left", "right", "full")
+        for p in range(nparts):
+            if not buckets_a[p] and not buckets_b[p]:
+                continue
+            if not outer and (not buckets_a[p] or not buckets_b[p]):
+                if self.join.how != "left_anti" or not buckets_a[p]:
+                    continue
+            ta = concat(buckets_a[p], self.scan_a)
+            tb = concat(buckets_b[p], self.scan_b)
+            buckets_a[p] = buckets_b[p] = None  # free host RAM as we go
+            chunk_plan = _splice(self.agg.child, {
+                id(self.scan_a): L.Relation(from_arrow(ta)),
+                id(self.scan_b): L.Relation(from_arrow(tb))})
+            partial = L.Aggregate(tuple(spec.groupings_exec),
+                                  key_aliases + tuple(spec.partials),
+                                  chunk_plan)
+            state.feed(partial)
+        metrics.record("grace_hash_agg", partitions=nparts,
+                       chunks=state.chunks)
+
+        if state.batch is None:
+            final0: L.LogicalPlan = L.Aggregate(
+                self.agg.groupings, self.agg.aggregates,
+                _splice(self.agg.child,
+                        {id(self.scan_a): _empty_rel(self.scan_a),
+                         id(self.scan_b): _empty_rel(self.scan_b)}))
+            for node in reversed(self.above):
+                final0 = node.with_children((final0,))
+            return run_fn(final0)
+        final: L.LogicalPlan = L.Project(tuple(spec.outputs),
+                                         L.Relation(state.batch))
+        for node in reversed(self.above):
+            final = node.with_children((final,))
+        return run_fn(final)
+
+
+@dataclasses.dataclass
+class _ChunkedTopK:
+    """Streamed top-k: Limit(Sort(per-row(big scan))) keeps a running
+    device top-(n+offset), merged per chunk (ExternalSorter's
+    TakeOrderedAndProjectExec shape)."""
+
+    above: List[L.LogicalPlan]  # Projects above the Limit
+    limit: L.Limit
+    sort: L.Sort
+    chain_root: L.LogicalPlan  # sort.child (per-row ops over the scan)
+    big: L.UnresolvedScan
+
+    def execute(self, conf, run_fn):
+        from spark_tpu import metrics
+        from spark_tpu.columnar.arrow import from_arrow
+
+        chunk_rows = conf.get(CHUNK_ROWS)
+        k = self.limit.n + self.limit.offset
+
+        def merge_plan(state_rel, chunk_plan):
+            child = chunk_plan if state_rel is None else L.Union(
+                state_rel,
+                L.Project(tuple(E.Col(n)
+                                for n in state_rel.schema.names),
+                          chunk_plan))
+            return L.Limit(k, L.Sort(self.sort.orders, child))
+
+        state = _MergeState(merge_plan, run_fn)
+        for tbl in self.big.source.iter_batches(
+                self.big.columns, self.big.filters, chunk_rows):
+            if tbl.num_rows == 0:
+                continue
+            chunk_plan = _splice(
+                self.chain_root,
+                {id(self.big): L.Relation(from_arrow(tbl))})
+            state.feed(chunk_plan)
+        metrics.record("chunked_topk", chunks=state.chunks, k=k)
+
+        if state.batch is None:
+            base: L.LogicalPlan = L.Limit(
+                self.limit.n,
+                L.Sort(self.sort.orders,
+                       _splice(self.chain_root,
+                               {id(self.big): _empty_rel(self.big)})),
+                offset=self.limit.offset)
+        else:
+            base = L.Limit(self.limit.n,
+                           L.Sort(self.sort.orders,
+                                  L.Relation(state.batch)),
+                           offset=self.limit.offset)
+        for node in reversed(self.above):
+            base = node.with_children((base,))
+        return run_fn(base)
+
+
+def find_chunkable(plan: L.LogicalPlan, conf):
+    """Detect an out-of-HBM-executable shape around over-budget scans;
+    returns an executable tier object (with .execute(conf, run_fn)) or
+    None to run the plan resident."""
+    budget = conf.get(MAX_DEVICE_BATCH_BYTES)
+    above, node = _peel_above(plan)
+
+    if isinstance(node, L.Aggregate):
+        return _find_agg(above, node, budget)
+
+    # top-k tier: Project* (Limit (Sort (per-row (big scan))))
+    above2: List[L.LogicalPlan] = []
+    n2 = plan
+    while isinstance(n2, L.Project):
+        above2.append(n2)
+        n2 = n2.children()[0]
+    if not isinstance(n2, L.Limit):
+        return None
+    lim = n2
+    if not isinstance(lim.child, L.Sort):
+        return None
+    sort = lim.child
+    node = sort.child
+    chain = node
+    while isinstance(node, (L.Filter, L.Project, L.SubqueryAlias)):
+        node = node.children()[0]
+    if not isinstance(node, L.UnresolvedScan):
+        return None
+    est = _est_scan(node)
+    if est is None or est <= budget:
+        return None
+    if lim.n + lim.offset > conf.get(CHUNK_ROWS):
+        return None  # running state would itself exceed a chunk
+    return _ChunkedTopK(above2, lim, sort, chain, node)
+
+
+def _find_agg(above, agg: L.Aggregate, budget: int):
+    try:
+        AggSpec(agg.groupings, agg.aggregates)
+    except NotImplementedError:
+        return None  # non-mergeable aggregate: execute directly
+    scans = L.collect_nodes(agg.child, L.UnresolvedScan)
+    ests = []
+    for s in scans:
+        e = _est_scan(s)
+        if e is None:
+            return None
+        ests.append(e)
+    big = [(s, e) for s, e in zip(scans, ests) if e > budget]
+    if not big:
+        return None
+
+    if len(big) == 1:
+        scan = big[0][0]
+        path = _stream_path(agg.child, scan)
+        if path is not None:
+            # every sidecar must itself fit the device budget
+            ok = True
+            for pj in path:
+                side_est = sum(
+                    _est_scan(s) or (budget + 1)
+                    for s in L.collect_nodes(pj.sidecar,
+                                             L.UnresolvedScan))
+                if side_est > budget:
+                    ok = False
+                    break
+            if ok:
+                return _ChunkedAgg(above, agg, scan, path)
+
+    if len(big) == 2:
+        gh = _find_grace(above, agg, big[0][0], big[1][0],
+                         big[0][1] + big[1][1])
+        if gh is not None:
+            return gh
+    return None
+
+
+def _find_grace(above, agg: L.Aggregate, sa: L.UnresolvedScan,
+                sb: L.UnresolvedScan, est_total: int):
+    """Shape check for tier 3: one join under the aggregate separates
+    the two big scans, with only per-row ops between."""
+    # find the join whose sides split {sa, sb}
+    joins = [j for j in L.collect_nodes(agg.child, L.Join)
+             if _contains(j.left, sa) != _contains(j.left, sb)]
+    if len(joins) != 1:
+        return None
+    join = joins[0]
+    if _contains(join.left, sb):
+        sa, sb = sb, sa
+    # per-row only between agg and the join, and join and each scan
+    node = agg.child
+    while node is not join:
+        if not isinstance(node, (L.Filter, L.Project, L.SubqueryAlias)):
+            return None
+        node = node.children()[0]
+
+    def per_row_to(root, target):
+        n = root
+        while n is not target:
+            if not isinstance(n, (L.Filter, L.Project, L.SubqueryAlias)):
+                return False
+            n = n.children()[0]
+        return True
+
+    if not per_row_to(join.left, sa) or not per_row_to(join.right, sb):
+        return None
+    if len(join.left_keys) != 1 or join.how == "cross":
+        return None
+    ka = _resolve_to_scan_col(join.left_keys[0], join.left, sa)
+    kb = _resolve_to_scan_col(join.right_keys[0], join.right, sb)
+    if ka is None or kb is None:
+        return None
+    from spark_tpu import types as T
+
+    for scan, key in ((sa, ka), (sb, kb)):
+        dt = scan.schema.field(key).dtype
+        if not (getattr(dt, "is_integral", False)
+                or isinstance(dt, (T.DateType, T.DecimalType))):
+            return None
+    return _GraceHashAgg(above, agg, join, sa, sb, ka, kb, est_total)
+
+
+def execute_chunked(found, conf, run_fn):
+    """Execute a chunkable plan (``found`` from find_chunkable);
+    ``run_fn(logical_plan) -> Batch`` is the engine (single-device or
+    mesh). Returns the final Batch."""
+    return found.execute(conf, run_fn)

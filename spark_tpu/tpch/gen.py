@@ -152,8 +152,21 @@ def _numbered_names(prefix: str, keys: np.ndarray) -> pa.Array:
 
 def generate_tables(sf: float = 0.01,
                     seed: int = 20260729) -> Dict[str, pa.Table]:
-    """All eight tables at scale factor ``sf`` (sf=1 is ~6M lineitems)."""
+    """All eight tables at scale factor ``sf`` (sf=1 is ~6M lineitems).
+    In-RAM path for sf <= ~10; above that use write_parquet_streamed
+    (SF100 lineitem alone would need ~80 GB of host arrays)."""
     rng = np.random.default_rng(seed)
+    tables, ctx = _gen_static(sf, rng)
+    n_ord = max(1, int(1_500_000 * sf))
+    orders, lineitem = _gen_orders_slice(rng, 1, n_ord + 1, ctx)
+    tables["orders"] = orders
+    tables["lineitem"] = lineitem
+    return tables
+
+
+def _gen_static(sf: float, rng) -> tuple:
+    """The six non-order tables plus the context the orders/lineitem
+    generator needs (part retail prices, key cardinalities)."""
     tables: Dict[str, pa.Table] = {}
 
     # region / nation --------------------------------------------------------
@@ -257,9 +270,20 @@ def generate_tables(sf: float = 0.01,
         "c_comment": _words_dict(rng, n_cust, 6),
     })
 
-    # orders ------------------------------------------------------------------
-    n_ord = max(1, int(1_500_000 * sf))
-    ok = np.arange(1, n_ord + 1)
+    ctx = {"n_part": n_part, "n_supp": n_supp, "n_cust": n_cust,
+           "ck": ck, "retail_cents": retail_cents}
+    return tables, ctx
+
+
+def _gen_orders_slice(rng, ok_lo: int, ok_hi: int,
+                      ctx: Dict) -> tuple:
+    """orders + their lineitems for order keys [ok_lo, ok_hi) — the unit
+    of streamed generation (SF100 cannot hold all 600M lineitems as host
+    arrays at once)."""
+    n_part, n_supp, n_cust = ctx["n_part"], ctx["n_supp"], ctx["n_cust"]
+    ck, retail_cents = ctx["ck"], ctx["retail_cents"]
+    n_ord = ok_hi - ok_lo
+    ok = np.arange(ok_lo, ok_hi)
     # spec: only 2/3 of customers have orders
     cust_with_orders = ck[ck % 3 != 0] if n_cust >= 3 else ck
     o_cust = cust_with_orders[rng.integers(0, len(cust_with_orders), n_ord)]
@@ -270,7 +294,7 @@ def generate_tables(sf: float = 0.01,
                                                 512), 2)]
     n_clerks = max(2, n_ord // 1000)
     clerk_vocab = _numbered("Clerk#", np.arange(1, n_clerks))
-    tables["orders"] = pa.table({
+    orders = pa.table({
         "o_orderkey": pa.array(ok, pa.int64()),
         "o_custkey": pa.array(o_cust, pa.int64()),
         "o_orderstatus": _pick_dict(rng, n_ord, ["O", "F", "P"]),
@@ -310,7 +334,7 @@ def generate_tables(sf: float = 0.01,
     # returnflag vocab [R, A, N]; linestatus vocab [O, F]
     rf_idx = np.where(receipt <= today, rng.integers(0, 2, n_li), 2)
     ls_idx = np.where(ship > today, 0, 1)
-    tables["lineitem"] = pa.table({
+    lineitem = pa.table({
         "l_orderkey": pa.array(l_order, pa.int64()),
         "l_partkey": pa.array(l_part, pa.int64()),
         "l_suppkey": pa.array(l_supp, pa.int64()),
@@ -331,7 +355,7 @@ def generate_tables(sf: float = 0.01,
         "l_shipmode": _pick_dict(rng, n_li, SHIPMODES),
         "l_comment": _words_dict(rng, n_li, 4),
     })
-    return tables
+    return orders, lineitem
 
 
 def _phones(rng, nationkeys: np.ndarray):
@@ -355,6 +379,67 @@ def write_parquet(tables: Dict[str, pa.Table], path: str) -> None:
     os.makedirs(path, exist_ok=True)
     for name, tbl in tables.items():
         pq.write_table(tbl, os.path.join(path, f"{name}.parquet"))
+
+
+def write_parquet_streamed(sf: float, path: str, seed: int = 20260729,
+                           orders_per_slice: int = 4_000_000) -> None:
+    """SF100-capable generation: the six static tables write whole;
+    orders/lineitem generate and write in bounded slices of
+    ``orders_per_slice`` orders (~4x lineitems), so peak host RAM is one
+    slice (~4 GB) instead of the full ~100 GB. orders.parquet /
+    lineitem.parquet become multi-file directories (the multi-part
+    dataset layout every dbgen -S chunk run produces)."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    statics, ctx = _gen_static(sf, rng)
+    for name, tbl in statics.items():
+        pq.write_table(tbl, os.path.join(path, f"{name}.parquet"))
+    statics.clear()
+    odir = os.path.join(path, "orders.parquet")
+    ldir = os.path.join(path, "lineitem.parquet")
+    os.makedirs(odir, exist_ok=True)
+    os.makedirs(ldir, exist_ok=True)
+    n_ord = max(1, int(1_500_000 * sf))
+    lo, i = 1, 0
+    while lo <= n_ord:
+        hi = min(lo + orders_per_slice, n_ord + 1)
+        srng = np.random.default_rng([seed, i])
+        orders, lineitem = _gen_orders_slice(srng, lo, hi, ctx)
+        pq.write_table(orders, os.path.join(odir, f"part-{i:05d}.parquet"),
+                       row_group_size=1 << 20)
+        pq.write_table(lineitem,
+                       os.path.join(ldir, f"part-{i:05d}.parquet"),
+                       row_group_size=1 << 20)
+        del orders, lineitem
+        lo, i = hi, i + 1
+
+
+def ensure_dataset(sf: float, base: str = "/tmp",
+                   seed: int = 20260729) -> str:
+    """Generate-once disk cache (SF100 generation is ~15 min of rng on
+    one core; benches must not pay it per run). Returns the dataset
+    directory; a _DONE marker guards against half-written caches."""
+    import os
+    import shutil
+
+    tag = f"{sf:g}".replace(".", "p")
+    path = os.path.join(base, f"tpch_sf{tag}")
+    marker = os.path.join(path, "_DONE")
+    if os.path.exists(marker):
+        return path
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    if sf <= 10:
+        write_parquet(generate_tables(sf, seed), path)
+    else:
+        write_parquet_streamed(sf, path, seed)
+    with open(marker, "w") as f:
+        f.write("ok")
+    return path
 
 
 def register_views(spark, tables: Optional[Dict[str, pa.Table]] = None,

@@ -129,3 +129,146 @@ def test_skewed_join_completes(spark):
     assert r["n"] == 5000
     want_s = sum((1 if i % 10 else i % 50) * 2 for i in range(5000))
     assert r["s"] == want_s
+
+
+@pytest.fixture()
+def join_parquet(spark, tmp_path):
+    """fact (200k rows, keys 0..999) + dim (keys 0..99 only: the
+    sidecar's key set filters 90% of fact rows host-side)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(12)
+    n = 200_000
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 1000, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })
+    # NON-contiguous keys (0,10,..,990): min/max scan pruning cannot
+    # help, so row drops must come from the membership filter
+    dim = pa.table({
+        "dk": pa.array(np.arange(100) * 10, pa.int64()),
+        "w": pa.array(np.arange(100) * 2, pa.int64()),
+    })
+    fp, dp = str(tmp_path / "fact.parquet"), str(tmp_path / "dim.parquet")
+    pq.write_table(fact, fp)
+    pq.write_table(dim, dp)
+    spark.read.parquet(fp).createOrReplaceTempView("oc_fact")
+    spark.read.parquet(dp).createOrReplaceTempView("oc_dim")
+    return fact, dim
+
+
+def _chunk_events(kind):
+    from spark_tpu import metrics
+
+    return [e for e in metrics.recent(500) if e["kind"] == kind]
+
+
+def test_streamed_join_aggregation(spark, join_parquet):
+    """Tier 2: big fact streams through the join; dim pre-materializes
+    once; the sidecar's key set drops non-matching fact rows host-side
+    before they ship to device."""
+    from spark_tpu import metrics
+
+    sql = ("select k % 10 as g, sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk group by k % 10 "
+           "order by g")
+    want = [(r.g, r.s, r.n) for r in spark.sql(sql).collect()]
+    assert want  # sanity: the resident run produced rows
+    # dim (~1.7 KB) stays under budget; fact (~3.4 MB) chunks
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 100_000)
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    try:
+        metrics.reset()
+        got = [(r.g, r.s, r.n) for r in spark.sql(sql).collect()]
+        evs = _chunk_events("chunked_agg")
+        assert evs and evs[-1]["chunks"] >= 2
+        assert evs[-1]["sidecars"] == 1
+        assert evs[-1]["key_filters"] == 1
+        # keys 100..999 never ship: ~90% dropped host-side
+        assert evs[-1]["rows_kept"] < 0.2 * evs[-1]["rows_in"]
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+    assert got == want
+
+
+def test_streamed_left_join_no_filter(spark, join_parquet):
+    """Left-outer keeps unmatched streamed rows, so the host-side key
+    filter must NOT engage."""
+    from spark_tpu import metrics
+
+    sql = ("select count(*) as n, count(w) as m, sum(v) as s "
+           "from oc_fact left join oc_dim on k = dk")
+    want = [(r.n, r.m, r.s) for r in spark.sql(sql).collect()]
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 100_000)
+    spark.conf.set("spark.tpu.chunkRows", 65_536)
+    try:
+        metrics.reset()
+        got = [(r.n, r.m, r.s) for r in spark.sql(sql).collect()]
+        evs = _chunk_events("chunked_agg")
+        assert evs and evs[-1]["key_filters"] == 0
+        assert evs[-1]["rows_kept"] == evs[-1]["rows_in"]
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+    assert got == want
+
+
+def test_grace_hash_join_aggregation(spark, join_parquet):
+    """Tier 3: both sides over budget -> hash-partitioned host buckets,
+    per-bucket device joins."""
+    from spark_tpu import metrics
+
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)  # both "big"
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    try:
+        metrics.reset()
+        got = [(r.s, r.n) for r in spark.sql(sql).collect()]
+        evs = _chunk_events("grace_hash_agg")
+        assert evs and evs[-1]["partitions"] >= 2
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+    assert got == want
+
+
+def test_grace_hash_left_join(spark, join_parquet):
+    from spark_tpu import metrics
+
+    sql = ("select count(*) as n, count(w) as m "
+           "from oc_fact left join oc_dim on k = dk")
+    want = [(r.n, r.m) for r in spark.sql(sql).collect()]
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    try:
+        metrics.reset()
+        got = [(r.n, r.m) for r in spark.sql(sql).collect()]
+        assert _chunk_events("grace_hash_agg")
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+    assert got == want
+
+
+def test_chunked_topk(spark, join_parquet):
+    """Streamed top-k: Limit(Sort(big scan)) merges a running device
+    top-k instead of materializing the scan."""
+    from spark_tpu import metrics
+
+    sql = ("select k, v from oc_fact where v >= 10 "
+           "order by v desc, k asc limit 7")
+    want = [(r.k, r.v) for r in spark.sql(sql).collect()]
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 100_000)
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    try:
+        metrics.reset()
+        got = [(r.k, r.v) for r in spark.sql(sql).collect()]
+        evs = _chunk_events("chunked_topk")
+        assert evs and evs[-1]["chunks"] >= 2
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+    assert got == want
