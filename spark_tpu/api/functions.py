@@ -567,6 +567,72 @@ def array_contains(c: ColumnOrName, value) -> Column:
     return E.ArrayContains(_c(c), v)
 
 
+def _lambda(fn) -> "E.Lambda":
+    """Python callable -> Lambda node: the callable's own parameter
+    names become the bound variables (pyspark's LambdaFunction shape,
+    reference: higherOrderFunctions.scala)."""
+    import inspect
+
+    params = tuple(inspect.signature(fn).parameters)
+    return E.Lambda(params, _c(fn(*[E.Col(p) for p in params])))
+
+
+def transform(c: ColumnOrName, fn) -> Column:
+    """transform(array, x -> ...) / (x, i) -> ... (reference:
+    functions.transform, ArrayTransform)."""
+    return E.HigherOrder("transform", _c(c), _lambda(fn))
+
+
+def filter(c: ColumnOrName, fn) -> Column:  # noqa: A001
+    return E.HigherOrder("filter", _c(c), _lambda(fn))
+
+
+def exists(c: ColumnOrName, fn) -> Column:
+    return E.HigherOrder("exists", _c(c), _lambda(fn))
+
+
+def forall(c: ColumnOrName, fn) -> Column:
+    return E.HigherOrder("forall", _c(c), _lambda(fn))
+
+
+def aggregate(c: ColumnOrName, zero, merge, finish=None) -> Column:
+    """aggregate(array, zero, (acc, x) -> ..., [acc -> ...]) (reference:
+    functions.aggregate, ArrayAggregate)."""
+    return E.HigherOrder(
+        "aggregate", _c(c), _lambda(merge), lit(zero),
+        None if finish is None else _lambda(finish))
+
+
+def collect_list(c: ColumnOrName) -> Column:
+    return E.Collect(_c(c))
+
+
+def collect_set(c: ColumnOrName) -> Column:
+    return E.Collect(_c(c), unique=True)
+
+
+array_agg = collect_list
+
+
+def percentile_approx(c: ColumnOrName, percentage: float,
+                      accuracy: int = 10000) -> Column:
+    """Value at the given percentile. The TPU build computes the EXACT
+    element (accuracy accepted for API parity, unused) — see
+    expr.expressions.Percentile."""
+    return E.Percentile(_c(c), float(percentage))
+
+
+approx_percentile = percentile_approx
+
+
+def percentile(c: ColumnOrName, percentage: float) -> Column:
+    return E.Percentile(_c(c), float(percentage), interpolate=True)
+
+
+def median(c: ColumnOrName) -> Column:
+    return E.Percentile(_c(c), 0.5, interpolate=True)
+
+
 def explode(c: ColumnOrName) -> Column:
     return E.Explode(_c(c))
 

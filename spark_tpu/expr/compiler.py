@@ -429,6 +429,9 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
         validity = _and_validity(tv.validity, vt.validity)
         return TV(res, validity, T.BOOLEAN, None)
 
+    if isinstance(expr, E.HigherOrder):
+        return _eval_higher_order(expr, env, n)
+
     if isinstance(expr, E.Explode):
         raise NotImplementedError(
             "explode() is a generator: only valid in a SELECT list or "
@@ -1042,3 +1045,105 @@ def _eval_case(expr: E.Case, env: Env) -> TV:
         valid = jnp.where(fire, v_valid, valid_arr)
         matched = matched | fire
     return TV(data, valid, out_dt, out_dict)
+
+
+def _eval_higher_order(expr: "E.HigherOrder", env: Env, n: int) -> TV:
+    """Higher-order array functions, vectorized on the padded layout
+    (reference: higherOrderFunctions.scala — there an interpreted
+    per-element lambda; here the lambda body traces ONCE over the
+    flattened (rows x max_len) element plane, so XLA fuses it like any
+    other columnar expression).
+
+    Null semantics deviations (documented): a NULL lambda result is not
+    representable as a null ELEMENT (types.ArrayType) — transform over
+    a nullable body refuses loudly like array(); exists/forall treat a
+    NULL predicate as false (three-valued NULL results are not
+    produced)."""
+    tv = evaluate(expr.child, env)
+    if tv.lengths is None or tv.data.ndim != 2:
+        raise NotImplementedError(f"{expr.kind}() over a non-array value")
+    width = tv.data.shape[1]
+    lens = tv.lengths.astype(jnp.int32)
+    alive = jnp.arange(width)[None, :] < lens[:, None]
+    params = expr.fn.params
+
+    if expr.kind == "aggregate":
+        return _eval_array_aggregate(expr, tv, lens, env, n)
+
+    # element-plane environment: outer row columns repeat per element
+    cols: Dict[str, TV] = {}
+    for name, otv in env.columns.items():
+        if otv.data.ndim != 1:
+            continue  # array-typed outer columns are not in scope
+        cols[name] = TV(
+            jnp.repeat(otv.data, width),
+            None if otv.validity is None
+            else jnp.repeat(otv.validity, width),
+            otv.dtype, otv.dictionary)
+    cols[params[0]] = TV(tv.data.reshape(-1), None, tv.dtype.element,
+                         tv.dictionary)
+    if len(params) > 1:  # (x, i) -> ...: 0-based position
+        cols[params[1]] = TV(
+            jnp.tile(jnp.arange(width, dtype=jnp.int32), n), None,
+            T.INT32, None)
+    res = evaluate(expr.fn.body, Env(cols, n * width))
+
+    if expr.kind == "transform":
+        if res.validity is not None:
+            raise NotImplementedError(
+                "transform() lambda with a nullable result: null array "
+                "elements are not representable — coalesce() inside the "
+                "lambda")
+        return TV(res.data.reshape(n, width), tv.validity,
+                  T.ArrayType(res.dtype), res.dictionary, lens)
+
+    pred = (res.data.astype(jnp.bool_)
+            & res.valid_or_true(n * width)).reshape(n, width)
+    if expr.kind == "filter":
+        keep = pred & alive
+        # stable per-row compaction: kept elements slide left
+        perm = jnp.argsort(~keep, axis=1, stable=True)
+        data = jnp.take_along_axis(tv.data, perm, axis=1)
+        return TV(data, tv.validity, tv.dtype, tv.dictionary,
+                  keep.sum(axis=1).astype(jnp.int32))
+    if expr.kind == "exists":
+        return TV(jnp.any(pred & alive, axis=1), tv.validity, T.BOOLEAN,
+                  None)
+    if expr.kind == "forall":
+        return TV(jnp.all(pred | ~alive, axis=1), tv.validity, T.BOOLEAN,
+                  None)
+    raise NotImplementedError(f"higher-order kind {expr.kind!r}")
+
+
+def _eval_array_aggregate(expr: "E.HigherOrder", tv: TV, lens, env: Env,
+                          n: int) -> TV:
+    """aggregate(arr, zero, (acc, x) -> ..., [acc -> ...]): a traced
+    fold, unrolled over the (small) max_len axis; each step is a full-
+    width vector op so rows fold in parallel."""
+    acc = evaluate(expr.zero, env)
+    if isinstance(acc.dtype, T.StringType):
+        raise NotImplementedError("aggregate() with a string accumulator")
+    acc_name, x_name = expr.fn.params
+    width = tv.data.shape[1]
+    for j in range(width):
+        cols = dict(env.columns)
+        cols[acc_name] = acc
+        cols[x_name] = TV(tv.data[:, j], None, tv.dtype.element,
+                          tv.dictionary)
+        new = evaluate(expr.fn.body, Env(cols, n))
+        ct = T.common_type(acc.dtype, new.dtype)
+        step = j < lens
+        data = jnp.where(step, _cast_data(new.data, new.dtype, ct),
+                         _cast_data(acc.data, acc.dtype, ct))
+        if acc.validity is None and new.validity is None:
+            validity = None
+        else:
+            validity = jnp.where(step, new.valid_or_true(n),
+                                 acc.valid_or_true(n))
+        acc = TV(data, validity, ct, None)
+    if expr.finish is not None:
+        cols = dict(env.columns)
+        cols[expr.finish.params[0]] = acc
+        acc = evaluate(expr.finish.body, Env(cols, n))
+    validity = _and_validity(tv.validity, acc.validity)
+    return TV(acc.data, validity, acc.dtype, acc.dictionary)

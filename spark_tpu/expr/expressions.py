@@ -509,6 +509,89 @@ class ArrayContains(Expression):
 
 
 @dataclass(eq=False, frozen=True)
+class Lambda(Expression):
+    """Anonymous function for higher-order array functions: ``x ->
+    body`` / ``(x, i) -> body`` (reference: LambdaFunction,
+    higherOrderFunctions.scala). Params bind as column names inside the
+    body, shadowing outer columns; the TPU evaluation vectorizes the
+    body over the flattened (rows x max_len) element plane — no per-
+    element interpretation."""
+
+    params: Tuple[str, ...]
+    body: Expression
+
+    def children(self):
+        return (self.body,)
+
+    def data_type(self, schema):
+        raise TypeError("a lambda has no standalone type")
+
+    def __str__(self):
+        ps = ", ".join(self.params)
+        return f"({ps}) -> {self.body}"
+
+
+def _with_fields(schema, extra_fields):
+    return T.Schema(tuple(schema.fields) + tuple(extra_fields))
+
+
+@dataclass(eq=False, frozen=True)
+class HigherOrder(Expression):
+    """transform / filter / exists / forall / aggregate over arrays
+    (reference: higherOrderFunctions.scala ArrayTransform/ArrayFilter/
+    ArrayExists/ArrayForAll/ArrayAggregate). ``zero``/``finish`` are for
+    ``aggregate`` only."""
+
+    kind: str  # transform | filter | exists | forall | aggregate
+    child: Expression
+    fn: Lambda
+    zero: Optional[Expression] = None
+    finish: Optional["Lambda"] = None
+
+    def children(self):
+        return (self.child, self.fn) + (
+            (self.zero,) if self.zero is not None else ())
+
+    def _element_schema(self, schema):
+        dt = self.child.data_type(schema)
+        if not isinstance(dt, T.ArrayType):
+            raise TypeError(f"{self.kind}() over non-array {dt!r}")
+        fields = [T.Field(self.fn.params[0], dt.element, False)]
+        if len(self.fn.params) > 1:
+            fields.append(T.Field(self.fn.params[1], T.INT32, False))
+        return _with_fields(schema, fields)
+
+    def data_type(self, schema):
+        if self.kind == "transform":
+            return T.ArrayType(
+                self.fn.body.data_type(self._element_schema(schema)))
+        if self.kind == "filter":
+            return self.child.data_type(schema)
+        if self.kind in ("exists", "forall"):
+            return T.BOOLEAN
+        if self.kind == "aggregate":
+            dt = self.child.data_type(schema)
+            acc_dt = self.zero.data_type(schema)
+            s2 = _with_fields(schema, [
+                T.Field(self.fn.params[0], acc_dt, False),
+                T.Field(self.fn.params[1], dt.element, False)])
+            acc_dt = T.common_type(acc_dt, self.fn.body.data_type(s2))
+            if self.finish is not None:
+                s3 = _with_fields(
+                    schema, [T.Field(self.finish.params[0], acc_dt,
+                                     False)])
+                return self.finish.body.data_type(s3)
+            return acc_dt
+        raise TypeError(f"unknown higher-order kind {self.kind!r}")
+
+    def __str__(self):
+        parts = [str(self.child), str(self.fn)]
+        if self.zero is not None:
+            parts.insert(1, str(self.zero))
+        return f"{self.kind}({', '.join(parts)})"
+
+
+@dataclass(eq=False, frozen=True)
 class Explode(Expression):
     """Generator marker: one output row per array element (reference:
     Explode/PosExplode, generators.scala). Only legal inside a
@@ -1426,6 +1509,67 @@ class First(AggregateExpression):
     @property
     def name(self):
         return f"first({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Collect(AggregateExpression):
+    """collect_list / collect_set: gather the group's values into an
+    array (reference: expressions/aggregate/collect.scala). Blocking-
+    only on device — the output width is the largest group's count, a
+    data-dependent shape (the sort-agg path host-syncs it alongside the
+    group count)."""
+
+    child: Expression
+    unique: bool = False  # collect_set
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return T.ArrayType(self.child.data_type(schema))
+
+    @property
+    def name(self):
+        return f"collect_{'set' if self.unique else 'list'}({self.child})"
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(eq=False, frozen=True)
+class Percentile(AggregateExpression):
+    """percentile_approx / median, computed EXACTLY per group by a
+    (group, value) lexsort + per-group rank gather — fully vectorized
+    over groups, no host sync (reference:
+    aggregate/ApproximatePercentile.scala:81, aggregate/Median uses
+    exact Percentile; the TPU build has no reason to approximate:
+    the sort is the same device sort every blocking aggregate pays).
+    ``interpolate`` (median / exact percentile) returns float64 between
+    ranks; otherwise the actual element at rank ceil(q*n) is returned in
+    the input's type, matching approx_percentile's contract of picking
+    a REAL element."""
+
+    child: Expression
+    percentage: float
+    interpolate: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        if self.interpolate:
+            return T.FLOAT64
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        fn = "median" if (self.interpolate and self.percentage == 0.5) \
+            else ("percentile" if self.interpolate else "percentile_approx")
+        arg = "" if fn == "median" else f", {self.percentage}"
+        return f"{fn}({self.child}{arg})"
 
     def __str__(self):
         return self.name

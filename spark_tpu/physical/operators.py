@@ -917,6 +917,54 @@ def _compute_agg(agg: E.AggregateExpression, env: Env, seg, mask,
             found & K.seg_first(tv.valid_or_true(capacity), seg, use,
                                 num_segments, capacity, sorted_seg)[0])
         return TV(data, valid, tv.dtype, tv.dictionary)
+    if isinstance(agg, E.Percentile):
+        # EXACT per-group percentile: one (group, value) lexsort, then a
+        # rank gather vectorized over all groups — same device sort
+        # every blocking aggregate pays, so no reason to approximate
+        # (reference: aggregate/ApproximatePercentile.scala:81)
+        q = float(agg.percentage)
+        perm = K.lexsort_permutation(
+            [K.SortKey(seg, None, True, True),
+             K.SortKey(tv.data, tv.validity, True, True)], ok)
+        svals = tv.data[perm]
+        cnt = K.seg_count(seg, ok, num_segments, sorted_seg)
+        starts = jnp.cumsum(cnt) - cnt
+        hi_cap = capacity - 1
+        if agg.interpolate:
+            fvals = C._cast_data(svals, tv.dtype, T.FLOAT64)
+            pos = q * (cnt - 1).astype(jnp.float64)
+            lo = jnp.floor(pos).astype(jnp.int64)
+            hi = jnp.ceil(pos).astype(jnp.int64)
+            frac = pos - lo.astype(jnp.float64)
+            vlo = fvals[jnp.clip(starts + lo, 0, hi_cap)]
+            vhi = fvals[jnp.clip(starts + hi, 0, hi_cap)]
+            return TV(vlo + (vhi - vlo) * frac, any_valid, T.FLOAT64,
+                      None)
+        rank = jnp.clip(jnp.ceil(q * cnt).astype(jnp.int64) - 1, 0,
+                        jnp.maximum(cnt - 1, 0))
+        data = svals[jnp.clip(starts + rank, 0, hi_cap)]
+        return TV(data, any_valid, tv.dtype, tv.dictionary)
+    if isinstance(agg, E.Collect):
+        import jax as _jax
+
+        if isinstance(seg, _jax.core.Tracer):
+            raise NotImplementedError(
+                "collect_list/collect_set have a data-dependent output "
+                "width (the largest group) — blocking execution only")
+        if agg.unique:
+            ok = ok & _distinct_mask_cached(env, agg.child, tv, seg, ok)
+        keys = [K.SortKey(seg, None, True, True)]
+        if agg.unique:
+            keys.append(K.SortKey(tv.data, tv.validity, True, True))
+        perm = K.lexsort_permutation(keys, ok)  # stable: keeps row order
+        svals = tv.data[perm]
+        cnt = K.seg_count(seg, ok, num_segments, sorted_seg)
+        starts = jnp.cumsum(cnt) - cnt
+        width = max(int(jnp.max(cnt)) if num_segments else 0, 1)
+        idx = starts[:, None] + jnp.arange(width)[None, :]
+        data2 = svals[jnp.clip(idx, 0, capacity - 1)]
+        return TV(data2, None, T.ArrayType(tv.dtype), tv.dictionary,
+                  cnt.astype(jnp.int32))
     raise NotImplementedError(f"aggregate {agg!r}")
 
 
@@ -947,6 +995,10 @@ class HashAggregateExec(PhysicalPlan):
 
     @property
     def traceable(self) -> bool:  # type: ignore[override]
+        if any(isinstance(a, E.Collect)
+               for e in self.aggregates
+               for a in E.collect_aggregates(e)):
+            return False  # output width = largest group: blocking only
         return self._static_direct_ok() or self.adaptive is not None
 
     def _static_direct_ok(self) -> bool:
@@ -982,8 +1034,12 @@ class HashAggregateExec(PhysicalPlan):
                 c = E.strip_alias(inner.child)
                 if isinstance(c, E.Col) and c.col_name in cs:
                     dictionary = cs.field(c.col_name).dictionary
-            fields.append(Field(e.name, e.data_type(cs), e.nullable(cs),
-                                dictionary))
+            dt = e.data_type(cs)
+            fields.append(Field(e.name, dt, e.nullable(cs), dictionary))
+            if isinstance(dt, T.ArrayType):
+                # hidden per-row length companion (types.ArrayType)
+                fields.append(Field(T.array_len_col(e.name), T.INT32,
+                                    nullable=False))
         return Schema(tuple(fields))
 
     # -- shared epilogue ------------------------------------------------------
@@ -1000,6 +1056,15 @@ class HashAggregateExec(PhysicalPlan):
             tv = C.evaluate(e, env)
             out_cols[e.name] = tv
             order.append(e.name)
+            if isinstance(tv.dtype, T.ArrayType):
+                ln = T.array_len_col(e.name)
+                out_cols[ln] = TV(
+                    (tv.lengths if tv.lengths is not None
+                     else jnp.full((num_segments,),
+                                   tv.data.shape[1] if tv.data.ndim > 1
+                                   else 0, dtype=jnp.int32)),
+                    None, T.INT32, None)
+                order.append(ln)
         return Pipe(out_cols, out_mask, order)
 
     # -- direct (packed-key) path --------------------------------------------

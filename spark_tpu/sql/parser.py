@@ -31,7 +31,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<qid>"[^"]*"|`[^`]*`)
-  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+  | (?P<op><>|!=|>=|<=|\|\||->|[=<>+\-*/%(),.;\[\]])
   | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
 """, re.VERBOSE | re.DOTALL)
 
@@ -209,11 +209,16 @@ class _ExprParser:
 
     def parse_predicate(self) -> E.Expression:
         if self.at_keyword("EXISTS"):
-            self.next()
-            self.expect("(")
-            plan = self.subquery_parser(self)
-            self.expect(")")
-            return E.Exists(plan)
+            nt = self.peek(2)
+            if nt.kind == "id" and nt.upper == "SELECT":
+                self.next()
+                self.expect("(")
+                plan = self.subquery_parser(self)
+                self.expect(")")
+                return E.Exists(plan)
+            # exists(array, x -> pred): the higher-order function form
+            name_tok = self.next()
+            return self._parse_function_inner(name_tok)
         left = self.parse_additive()
         negated = bool(self.accept("NOT"))
         t = self.peek()
@@ -705,6 +710,42 @@ class _ExprParser:
             from spark_tpu.api import functions as F
 
             return F.concat_ws(sep, *args)
+        if name in ("TRANSFORM", "FILTER", "EXISTS", "FORALL"):
+            arr = self.parse()
+            self.expect(",")
+            lam = self._parse_lambda()
+            self.expect(")")
+            return E.HigherOrder(name.lower(), arr, lam)
+        if name in ("AGGREGATE", "REDUCE"):
+            arr = self.parse()
+            self.expect(",")
+            zero = self.parse()
+            self.expect(",")
+            merge = self._parse_lambda()
+            finish = None
+            if self.accept(","):
+                finish = self._parse_lambda()
+            self.expect(")")
+            return E.HigherOrder("aggregate", arr, merge, zero, finish)
+        if name in ("COLLECT_LIST", "COLLECT_SET", "ARRAY_AGG"):
+            e = self.parse()
+            self.expect(")")
+            return E.Collect(e, unique=(name == "COLLECT_SET"))
+        if name in ("PERCENTILE", "PERCENTILE_APPROX", "APPROX_PERCENTILE",
+                    "MEDIAN"):
+            e = self.parse()
+            if name == "MEDIAN":
+                self.expect(")")
+                return E.Percentile(e, 0.5, interpolate=True)
+            self.expect(",")
+            q = self.parse()
+            if not isinstance(q, E.Literal):
+                raise SQLParseError("percentile fraction must be a literal")
+            if self.accept(","):
+                self.parse()  # accuracy accepted, unused (exact result)
+            self.expect(")")
+            return E.Percentile(e, float(q.value),
+                                interpolate=(name == "PERCENTILE"))
         if name in _COMPOSED_FUNCTIONS:
             args = []
             if not self.accept(")"):
@@ -726,6 +767,35 @@ class _ExprParser:
             return builder(*args)
         raise SQLParseError(f"unknown function {name_tok.value!r} "
                             f"at {name_tok.pos}")
+
+    def _parse_lambda(self) -> "E.Lambda":
+        """``x -> body`` / ``(x, i) -> body`` (reference: LambdaFunction,
+        higherOrderFunctions.scala). Params shadow outer columns inside
+        the body — resolution is wrapped, not scoped-table-based."""
+        params = []
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()
+            params.append(self.next().value)
+            while self.accept(","):
+                params.append(self.next().value)
+            self.expect(")")
+        else:
+            params.append(self.next().value)
+        self.expect("->")
+        by_lower = {p.lower(): p for p in params}
+        outer_resolve = self.resolve
+
+        def resolve(qual, name):
+            if qual is None and name.lower() in by_lower:
+                return E.Col(by_lower[name.lower()])
+            return outer_resolve(qual, name)
+
+        self.resolve = resolve
+        try:
+            body = self.parse()
+        finally:
+            self.resolve = outer_resolve
+        return E.Lambda(tuple(params), body)
 
     def _str_literal(self) -> str:
         e = self.parse_primary()

@@ -1,0 +1,131 @@
+"""Higher-order array functions + collection aggregates + percentiles
+(reference: expressions/higherOrderFunctions.scala,
+expressions/aggregate/collect.scala, ApproximatePercentile.scala:81).
+The TPU build vectorizes lambdas over the padded element plane and
+computes percentiles exactly via per-group rank gathers."""
+
+import pytest
+
+from spark_tpu.api import functions as F
+
+
+@pytest.fixture()
+def hdf(spark):
+    df = spark.createDataFrame([
+        {"k": 1, "a": [1, 2, 3], "b": 10},
+        {"k": 1, "a": [4], "b": 20},
+        {"k": 2, "a": [], "b": 30},
+        {"k": 2, "a": [7, 7, 8], "b": 40},
+    ])
+    df.createOrReplaceTempView("hof")
+    return df
+
+
+def test_transform(spark, hdf):
+    got = spark.sql("select transform(a, x -> x * 2) as t from hof").collect()
+    assert [r.t for r in got] == [[2, 4, 6], [8], [], [14, 14, 16]]
+
+
+def test_transform_outer_column_and_index(spark, hdf):
+    got = spark.sql(
+        "select transform(a, x -> x + b) as t from hof").collect()
+    assert [r.t for r in got] == [[11, 12, 13], [24], [], [47, 47, 48]]
+    got2 = hdf.select(F.transform("a", lambda x, i: x * 10 + i)
+                      .alias("t")).collect()
+    assert [r.t for r in got2] == [[10, 21, 32], [40], [], [70, 71, 82]]
+
+
+def test_filter_exists_forall(spark, hdf):
+    got = spark.sql(
+        "select filter(a, x -> x % 2 = 0) as f, exists(a, x -> x > 5) "
+        "as e, forall(a, x -> x < 5) as fa from hof").collect()
+    assert [r.f for r in got] == [[2], [4], [], [8]]
+    assert [r.e for r in got] == [False, False, False, True]
+    assert [r.fa for r in got] == [True, True, True, False]
+
+
+def test_exists_subquery_still_parses(spark, hdf):
+    got = spark.sql(
+        "select k from hof h where exists "
+        "(select 1 from hof i where i.k = h.k and i.b > 35)").collect()
+    assert sorted(r.k for r in got) == [2, 2]
+
+
+def test_aggregate_fold(spark, hdf):
+    got = spark.sql(
+        "select aggregate(a, 0, (acc, x) -> acc + x) as s, "
+        "aggregate(a, 1, (acc, x) -> acc * x, acc -> -acc) as p "
+        "from hof").collect()
+    assert [r.s for r in got] == [6, 4, 0, 22]
+    assert [r.p for r in got] == [-6, -4, -1, -392]
+
+
+def test_collect_list_and_set(spark, hdf):
+    got = spark.sql(
+        "select k, collect_list(b) as l, collect_set(b % 20) as s "
+        "from hof group by k order by k").collect()
+    assert [r.l for r in got] == [[10, 20], [30, 40]]
+    assert [sorted(r.s) for r in got] == [[0, 10], [0, 10]]
+
+
+def test_collect_list_strings_and_nulls(spark):
+    df = spark.createDataFrame([
+        {"k": 1, "s": "b"}, {"k": 1, "s": "a"}, {"k": 1, "s": None},
+        {"k": 2, "s": "a"}, {"k": 1, "s": "a"},
+    ])
+    df.createOrReplaceTempView("cstr")
+    got = spark.sql("select k, collect_list(s) as l, collect_set(s) as d "
+                    "from cstr group by k order by k").collect()
+    # nulls are excluded (collect.scala semantics)
+    assert got[0].l == ["b", "a", "a"] and sorted(got[0].d) == ["a", "b"]
+    assert got[1].l == ["a"] and got[1].d == ["a"]
+
+
+def test_collect_roundtrip_to_arrow(spark, hdf):
+    tbl = (hdf.groupBy("k").agg(F.collect_list("b").alias("l"))
+           .orderBy("k").toArrow())
+    assert tbl.column("l").to_pylist() == [[10, 20], [30, 40]]
+
+
+def test_percentile_and_median(spark):
+    df = spark.createDataFrame(
+        [{"k": i % 2, "v": float(i)} for i in range(1, 11)])
+    df.createOrReplaceTempView("pct")
+    got = spark.sql(
+        "select k, percentile_approx(v, 0.5) as p, median(v) as m, "
+        "percentile(v, 0.25) as q from pct group by k order by k"
+    ).collect()
+    # k=0: values 2,4,6,8,10; k=1: 1,3,5,7,9
+    assert [r.p for r in got] == [6.0, 5.0]
+    assert [r.m for r in got] == [6.0, 5.0]
+    assert got[0].q == pytest.approx(4.0)
+    assert got[1].q == pytest.approx(3.0)
+
+
+def test_median_interpolates_even_count(spark):
+    df = spark.createDataFrame([{"v": v} for v in [1.0, 2.0, 10.0, 20.0]])
+    r = df.agg(F.median("v").alias("m"),
+               F.percentile_approx("v", 0.5).alias("p")).collect()[0]
+    assert r.m == pytest.approx(6.0)  # (2+10)/2
+    assert r.p == 2.0  # the actual element at rank ceil(0.5*4)
+
+
+def test_percentile_nulls_and_global(spark):
+    df = spark.createDataFrame(
+        [{"v": 1.0}, {"v": None}, {"v": 3.0}, {"v": None}])
+    r = df.agg(F.median("v").alias("m")).collect()[0]
+    assert r.m == pytest.approx(2.0)
+    import pyarrow as pa
+
+    empty = spark.createDataFrame(
+        pa.table({"v": pa.array([None, None], pa.float64())}))
+    r2 = empty.agg(F.median("v").alias("m")).collect()[0]
+    assert r2.m is None
+
+
+def test_transform_nullable_body_refuses(spark):
+    df = spark.createDataFrame([{"a": [1, 2], "n": 5},
+                                {"a": [3], "n": None}])
+    with pytest.raises(NotImplementedError, match="nullable"):
+        df.select(F.transform("a", lambda x: x + F.col("n"))
+                  .alias("t")).collect()
