@@ -567,6 +567,42 @@ def array_contains(c: ColumnOrName, value) -> Column:
     return E.ArrayContains(_c(c), v)
 
 
+def create_map(*cols) -> Column:
+    """map(k1, v1, k2, v2, ...) — legal at the top of a projection (the
+    Project expands it into '#keys'/'#vals' components, types.MapType;
+    reference: functions.map / CreateMap)."""
+    return E.CreateMap(tuple(_c(c) for c in cols))
+
+
+def map_from_arrays(keys: ColumnOrName, vals: ColumnOrName) -> Column:
+    return E.MapFromArrays(_c(keys), _c(vals))
+
+
+def _map_base(c: ColumnOrName) -> str:
+    if isinstance(c, str):
+        name = c
+    elif isinstance(c, E.Col):
+        name = c.col_name
+    else:
+        raise TypeError(
+            "map accessors need a map COLUMN reference (maps are "
+            "decomposed into component columns — types.MapType)")
+    base = T.map_base_name(name)
+    return base if base is not None else name
+
+
+def map_keys(c: ColumnOrName) -> Column:
+    return E.Col(T.map_keys_col(_map_base(c)))
+
+
+def map_values(c: ColumnOrName) -> Column:
+    return E.Col(T.map_vals_col(_map_base(c)))
+
+
+def map_contains_key(c: ColumnOrName, key) -> Column:
+    return E.ArrayContains(map_keys(c), lit(key))
+
+
 def _lambda(fn) -> "E.Lambda":
     """Python callable -> Lambda node: the callable's own parameter
     names become the bound variables (pyspark's LambdaFunction shape,

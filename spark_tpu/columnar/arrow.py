@@ -250,6 +250,22 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> Batch:
             for i, f in enumerate(col.type):
                 add(f"{name}.{f.name}", col.field(i), pv)
             return
+        if pa.types.is_map(col.type):
+            # map<k,v> DECOMPOSES into parallel '#keys'/'#vals' array
+            # columns sharing lengths (types.MapType); the map's own
+            # nulls ride as parent validity on both components
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            pv = parent_valid
+            if col.null_count > 0:
+                mv = pc.is_valid(col).to_numpy(zero_copy_only=False)
+                pv = mv if pv is None else (pv & mv)
+            offsets = col.offsets
+            add(T.map_keys_col(name),
+                pa.ListArray.from_arrays(offsets, col.keys), pv)
+            add(T.map_vals_col(name),
+                pa.ListArray.from_arrays(offsets, col.items), pv)
+            return
         if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
             vals, lengths, validity, dictionary, el_dtype = \
                 _list_to_padded(col)
@@ -304,35 +320,62 @@ def to_arrow(batch: Batch) -> pa.Table:
                                            host_cols)}
     hidden = {T.array_len_col(f.name) for f in batch.schema.fields
               if isinstance(f.dtype, T.ArrayType)}
+    def rebuild_list(f, cdata, cvalid):
+        """Padded 2D + '#len' companion -> (offsets int32 np, flat
+        values pa.Array, valid np bool|None)."""
+        data = cdata[mask]
+        valid = None if cvalid is None else cvalid[mask]
+        comp = by_name.get(T.array_len_col(f.name))
+        lens = (comp[0][mask].astype(np.int64) if comp is not None
+                else np.full(len(data), data.shape[1], np.int64))
+        if valid is not None:
+            lens = np.where(valid, lens, 0)
+        offsets = np.zeros(len(data) + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        jj = np.arange(data.shape[1])[None, :]
+        alive = jj < lens[:, None]
+        flat = data[alive]
+        if isinstance(f.dtype.element, T.StringType):
+            d = list(f.dictionary or ())
+            values = pa.DictionaryArray.from_arrays(
+                pa.array(flat.astype(np.int32), pa.int32()),
+                pa.array(d, pa.string())).cast(pa.string())
+        elif isinstance(f.dtype.element, T.DecimalType):
+            # flat holds UNSCALED scaled-int64 values — route through
+            # the raw-buffer rebuild like the scalar decimal branch
+            values = decimal_from_unscaled(
+                flat, dtype_to_arrow_type(f.dtype.element))
+        else:
+            values = pa.array(
+                flat, type=dtype_to_arrow_type(f.dtype.element))
+        return offsets, values, valid
+
+    field_by_name = {f.name: f for f in batch.schema.fields}
     for f, (cdata, cvalid) in zip(batch.schema.fields, host_cols):
         if f.name in hidden:
             continue
         if isinstance(f.dtype, T.ArrayType):
-            data = cdata[mask]
-            valid = None if cvalid is None else cvalid[mask]
-            comp = by_name.get(T.array_len_col(f.name))
-            lens = (comp[0][mask].astype(np.int64) if comp is not None
-                    else np.full(len(data), data.shape[1], np.int64))
-            if valid is not None:
-                lens = np.where(valid, lens, 0)
-            offsets = np.zeros(len(data) + 1, dtype=np.int32)
-            np.cumsum(lens, out=offsets[1:])
-            jj = np.arange(data.shape[1])[None, :]
-            alive = jj < lens[:, None]
-            flat = data[alive]
-            if isinstance(f.dtype.element, T.StringType):
-                d = list(f.dictionary or ())
-                values = pa.DictionaryArray.from_arrays(
-                    pa.array(flat.astype(np.int32), pa.int32()),
-                    pa.array(d, pa.string())).cast(pa.string())
-            elif isinstance(f.dtype.element, T.DecimalType):
-                # flat holds UNSCALED scaled-int64 values — route through
-                # the raw-buffer rebuild like the scalar decimal branch
-                values = decimal_from_unscaled(
-                    flat, dtype_to_arrow_type(f.dtype.element))
-            else:
-                values = pa.array(
-                    flat, type=dtype_to_arrow_type(f.dtype.element))
+            base = T.map_base_name(f.name)
+            sibling = (T.map_vals_col(base) if base is not None
+                       and f.name.endswith(T.MAP_KEYS_SUFFIX) else None)
+            if base is not None and sibling in field_by_name:
+                # '#keys'/'#vals' pair -> one arrow map column
+                offsets, keys, valid = rebuild_list(f, cdata, cvalid)
+                vf = field_by_name[sibling]
+                _, items, _ = rebuild_list(vf, *by_name[sibling])
+                off = pa.array(
+                    offsets, pa.int32(),
+                    mask=(np.concatenate([~valid, [False]])
+                          if valid is not None and not valid.all()
+                          else None))
+                columns.append(pa.MapArray.from_arrays(off, keys, items))
+                names.append(base)
+                continue
+            if base is not None \
+                    and f.name.endswith(T.MAP_VALS_SUFFIX) \
+                    and T.map_keys_col(base) in field_by_name:
+                continue  # emitted with its '#keys' sibling
+            offsets, values, valid = rebuild_list(f, cdata, cvalid)
             if valid is not None and not valid.all():
                 arr = pa.ListArray.from_arrays(
                     pa.array(offsets, pa.int32()), values,

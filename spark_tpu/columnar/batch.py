@@ -256,10 +256,32 @@ class Batch:
             else:
                 vals = [d.item() if v else None for d, v in zip(data, valid)]
             cols.append(vals)
-        for i in range(len(cols[0]) if cols else 0):
-            out_rows.append(
-                {f.name: cols[j][i] for j, f in enumerate(out_fields)}
-            )
+        # pair '#keys'/'#vals' components back into map dicts
+        # (types.MapType decomposition)
+        from spark_tpu.types import map_base_name, map_keys_col, \
+            map_vals_col
+
+        idx = {f.name: j for j, f in enumerate(out_fields)}
+        emit: list = []  # (output name, column index | (kj, vj))
+        for j, f in enumerate(out_fields):
+            base = map_base_name(f.name)
+            if base is not None and map_keys_col(base) in idx \
+                    and map_vals_col(base) in idx:
+                if f.name.endswith("#keys"):
+                    emit.append((base, (j, idx[map_vals_col(base)])))
+                continue  # '#vals' rides with its '#keys' sibling
+            emit.append((f.name, j))
+        n_rows = len(cols[0]) if cols else 0
+        for i in range(n_rows):
+            row = {}
+            for name, j in emit:
+                if isinstance(j, tuple):
+                    kj, vj = j
+                    ks, vs = cols[kj][i], cols[vj][i]
+                    row[name] = None if ks is None else dict(zip(ks, vs))
+                else:
+                    row[name] = cols[j][i]
+            out_rows.append(row)
         return out_rows
 
     def to_pandas(self):

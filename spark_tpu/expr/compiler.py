@@ -249,6 +249,11 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
         try:
             tv = env.columns[expr.col_name]
         except KeyError:
+            # a bare MAP reference resolves to its '#keys' component,
+            # the canonical map handle (types.MapType)
+            kc = T.map_keys_col(expr.col_name)
+            if kc in env.columns:
+                return evaluate(E.Col(kc), env)
             raise KeyError(
                 f"column {expr.col_name!r} not in {sorted(env.columns)}")
         if isinstance(tv.dtype, T.ArrayType) and tv.lengths is None:
@@ -388,14 +393,21 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
                   None)
 
     if isinstance(expr, E.ElementAt):
+        pair = _map_pair(expr.child, env)
+        if pair is not None:
+            return _map_get(pair, evaluate(expr.index, env), n)
         tv = evaluate(expr.child, env)
         it = evaluate(expr.index, env)
         if tv.lengths is None or tv.data.ndim != 2:
             raise NotImplementedError("element_at over a non-array value")
         idx = it.data.astype(jnp.int32)
         lens = tv.lengths.astype(jnp.int32)
-        pos = jnp.where(idx > 0, idx - 1, lens + idx)
-        ok = (pos >= 0) & (pos < lens) & (idx != 0)
+        if expr.sql_subscript:  # x[i]: 0-based (GetArrayItem)
+            pos = idx
+            ok = (pos >= 0) & (pos < lens)
+        else:
+            pos = jnp.where(idx > 0, idx - 1, lens + idx)
+            ok = (pos >= 0) & (pos < lens) & (idx != 0)
         got = jnp.take_along_axis(
             tv.data, jnp.clip(pos, 0, max(tv.data.shape[1] - 1, 0))[:, None],
             axis=1)[:, 0]
@@ -431,6 +443,12 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
 
     if isinstance(expr, E.HigherOrder):
         return _eval_higher_order(expr, env, n)
+
+    if isinstance(expr, (E.CreateMap, E.MapFromArrays)):
+        raise NotImplementedError(
+            "map-typed expressions are only legal at the top of a "
+            "projection (the Project expands them into '#keys'/'#vals' "
+            "component columns — types.MapType)")
 
     if isinstance(expr, E.Explode):
         raise NotImplementedError(
@@ -1147,3 +1165,72 @@ def _eval_array_aggregate(expr: "E.HigherOrder", tv: TV, lens, env: Env,
         acc = evaluate(expr.finish.body, Env(cols, n))
     validity = _and_validity(tv.validity, acc.validity)
     return TV(acc.data, validity, acc.dtype, acc.dictionary)
+
+
+def _map_pair(child: "E.Expression", env: Env):
+    """(keys TV, vals TV) when ``child`` references a decomposed MAP
+    column (types.MapType); None otherwise."""
+    if not isinstance(child, E.Col):
+        return None
+    nm = child.col_name
+    if nm.endswith(T.MAP_KEYS_SUFFIX):
+        base = nm[:-len(T.MAP_KEYS_SUFFIX)]
+    elif T.map_keys_col(nm) in env.columns:
+        base = nm
+    else:
+        return None
+    kc, vc = T.map_keys_col(base), T.map_vals_col(base)
+    if kc not in env.columns or vc not in env.columns:
+        return None
+    return evaluate(E.Col(kc), env), evaluate(E.Col(vc), env)
+
+
+def _map_get(pair, needle: TV, n: int) -> TV:
+    """element_at(map, key) / m[key]: vectorized key match over the
+    padded keys plane + take_along_axis into the values plane
+    (reference: GetMapValue, complexTypeExtractors.scala). Missing key
+    -> NULL."""
+    ktv, vtv = pair
+    if ktv.lengths is None or ktv.data.ndim != 2:
+        raise NotImplementedError("element_at over a non-map value")
+    width = ktv.data.shape[1]
+    alive = jnp.arange(width)[None, :] < ktv.lengths[:, None]
+    if isinstance(ktv.dtype.element, T.StringType):
+        union, (tk, tn) = unify_dictionaries(
+            (ktv.dictionary or (), needle.dictionary or ()))
+        kdata = (jnp.asarray(tk)[ktv.data]
+                 if len(ktv.dictionary or ()) else ktv.data)
+        ndata = (jnp.asarray(tn)[needle.data]
+                 if len(needle.dictionary or ()) else needle.data)
+        eq = kdata == ndata[:, None]
+    else:
+        ct = T.common_type(ktv.dtype.element, needle.dtype)
+        eq = (_cast_data(ktv.data, ktv.dtype.element, ct)
+              == _cast_data(needle.data, needle.dtype, ct)[:, None])
+    eq = eq & alive
+    found = jnp.any(eq, axis=1)
+    pos = jnp.argmax(eq, axis=1)
+    out = jnp.take_along_axis(vtv.data, pos[:, None], axis=1)[:, 0]
+    validity = (ktv.valid_or_true(n) & needle.valid_or_true(n) & found)
+    return TV(out, validity, vtv.dtype.element, vtv.dictionary)
+
+
+def evaluate_map_pair(expr: "E.Expression", env: Env):
+    """Evaluate a map-typed projection expression to its (keys TV,
+    vals TV) component pair — the Project-level expansion point for
+    CreateMap / MapFromArrays / map column references."""
+    expr = E.strip_alias(expr)
+    if isinstance(expr, E.CreateMap):
+        ktv = evaluate(E.MakeArray(expr.args[::2]), env)
+        vtv = evaluate(E.MakeArray(expr.args[1::2]), env)
+        return ktv, vtv
+    if isinstance(expr, E.MapFromArrays):
+        ktv = evaluate(expr.keys, env)
+        vtv = evaluate(expr.vals, env)
+        if ktv.lengths is None or vtv.lengths is None:
+            raise NotImplementedError("map_from_arrays needs array inputs")
+        return ktv, vtv
+    pair = _map_pair(expr, env)
+    if pair is not None:
+        return pair
+    raise NotImplementedError(f"not a map-typed expression: {expr}")

@@ -470,10 +470,14 @@ class Size(Expression):
 @dataclass(eq=False, frozen=True)
 class ElementAt(Expression):
     """element_at(array, i): 1-based, negative from the end, NULL when
-    out of range (reference: ElementAt, collectionOperations.scala)."""
+    out of range (reference: ElementAt, collectionOperations.scala).
+    Over a MAP column the index is a KEY lookup (GetMapValue).
+    ``sql_subscript`` marks the ``x[i]`` form, which is 0-based for
+    arrays (GetArrayItem) but still a key lookup for maps."""
 
     child: Expression
     index: Expression
+    sql_subscript: bool = False
 
     def children(self):
         return (self.child, self.index)
@@ -506,6 +510,65 @@ class ArrayContains(Expression):
 
     def __str__(self):
         return f"array_contains({self.child}, {self.value})"
+
+
+@dataclass(eq=False, frozen=True)
+class MapHandle(Col):
+    """A BARE reference to a decomposed MAP column, resolved to its
+    '#keys' component (the canonical handle, types.MapType). Evaluates
+    exactly like Col; the SQL select list uses the marker to expand a
+    selected map to its component pair (map_keys() returns a plain Col
+    and is NOT expanded)."""
+
+
+@dataclass(eq=False, frozen=True)
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) constructor (reference: CreateMap,
+    complexTypeCreator.scala). Map-typed expressions are only legal at
+    the top of a projection — the physical Project expands them into
+    the '#keys'/'#vals' component pair (types.MapType)."""
+
+    args: Tuple[Expression, ...]
+
+    def __post_init__(self):
+        if len(self.args) % 2:
+            raise TypeError("map() needs an even argument count")
+
+    def children(self):
+        return self.args
+
+    def data_type(self, schema):
+        kt = self.args[0].data_type(schema)
+        vt = self.args[1].data_type(schema)
+        for k in self.args[2::2]:
+            kt = T.common_type(kt, k.data_type(schema))
+        for v in self.args[3::2]:
+            vt = T.common_type(vt, v.data_type(schema))
+        return T.MapType(kt, vt)
+
+    def __str__(self):
+        return f"map({', '.join(str(a) for a in self.args)})"
+
+
+@dataclass(eq=False, frozen=True)
+class MapFromArrays(Expression):
+    """map_from_arrays(keys, values) (reference: MapFromArrays)."""
+
+    keys: Expression
+    vals: Expression
+
+    def children(self):
+        return (self.keys, self.vals)
+
+    def data_type(self, schema):
+        kt = self.keys.data_type(schema)
+        vt = self.vals.data_type(schema)
+        if not isinstance(kt, T.ArrayType) or not isinstance(vt, T.ArrayType):
+            raise TypeError("map_from_arrays needs two array inputs")
+        return T.MapType(kt.element, vt.element)
+
+    def __str__(self):
+        return f"map_from_arrays({self.keys}, {self.vals})"
 
 
 @dataclass(eq=False, frozen=True)

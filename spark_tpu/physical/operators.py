@@ -247,11 +247,21 @@ class ProjectExec(PhysicalPlan):
         cs = self.child.schema
         fields = []
         for e in self.exprs:
+            dt = e.data_type(cs)
+            if isinstance(dt, T.MapType):
+                # maps decompose into '#keys'/'#vals' array components
+                # plus their length companions (types.MapType)
+                nullable = e.nullable(cs)
+                for comp, el in ((T.map_keys_col(e.name), dt.key),
+                                 (T.map_vals_col(e.name), dt.value)):
+                    fields.append(Field(comp, T.ArrayType(el), nullable))
+                    fields.append(Field(T.array_len_col(comp), T.INT32,
+                                        nullable=False))
+                continue
             inner = E.strip_alias(e)
             dictionary = None
             if isinstance(inner, E.Col) and inner.col_name in cs:
                 dictionary = cs.field(inner.col_name).dictionary
-            dt = e.data_type(cs)
             fields.append(Field(e.name, dt, e.nullable(cs), dictionary))
             if isinstance(dt, T.ArrayType):
                 # hidden per-row length companion (types.ArrayType)
@@ -264,18 +274,35 @@ class ProjectExec(PhysicalPlan):
         env = pipe.env()
         cols = {}
         order = []
+
+        def add_array(name, tv):
+            cols[name] = tv
+            order.append(name)
+            ln = T.array_len_col(name)
+            cols[ln] = TV(
+                (tv.lengths if tv.lengths is not None
+                 else jnp.full((pipe.capacity,),
+                               tv.data.shape[1] if tv.data.ndim > 1
+                               else 0, dtype=jnp.int32)),
+                None, T.INT32, None)
+            order.append(ln)
+
         for e in self.exprs:
+            try:
+                dt = e.data_type(self.child.schema)
+            except Exception:
+                dt = None
+            if isinstance(dt, T.MapType):
+                ktv, vtv = C.evaluate_map_pair(e, env)
+                add_array(T.map_keys_col(e.name), ktv)
+                add_array(T.map_vals_col(e.name), vtv)
+                continue
             tv = C.evaluate(e, env)
+            if isinstance(tv.dtype, T.ArrayType):
+                add_array(e.name, tv)
+                continue
             cols[e.name] = tv
             order.append(e.name)
-            if isinstance(tv.dtype, T.ArrayType):
-                ln = T.array_len_col(e.name)
-                lengths = (tv.lengths if tv.lengths is not None
-                           else jnp.full((pipe.capacity,), tv.data.shape[1]
-                                         if tv.data.ndim > 1 else 0,
-                                         dtype=jnp.int32))
-                cols[ln] = TV(lengths, None, T.INT32, None)
-                order.append(ln)
         return Pipe(cols, pipe.mask, order)
 
     def node_string(self):

@@ -216,6 +216,15 @@ class Project(LogicalPlan):
         fields = []
         for e in self.exprs:
             dt = e.data_type(cs)
+            if isinstance(dt, T.MapType):
+                # maps decompose into '#keys'/'#vals' array components
+                # (types.MapType)
+                nullable = e.nullable(cs)
+                fields.append(Field(T.map_keys_col(e.name),
+                                    T.ArrayType(dt.key), nullable))
+                fields.append(Field(T.map_vals_col(e.name),
+                                    T.ArrayType(dt.value), nullable))
+                continue
             inner = E.strip_alias(e)
             dictionary = None
             if isinstance(inner, E.Col) and inner.col_name in cs:

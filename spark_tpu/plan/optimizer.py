@@ -386,6 +386,17 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
     FileSourceStrategy's readDataColumns)."""
 
     def prune(node: L.LogicalPlan, required: set) -> L.LogicalPlan:
+        # map components travel as a unit: a reference to 'm#keys'
+        # (the canonical map handle) must keep 'm#vals' alive and vice
+        # versa — element_at/m[k] reads both (types.MapType)
+        extra = set()
+        for n in required:
+            base = T.map_base_name(n)
+            if base is not None:
+                extra.add(T.map_keys_col(base))
+                extra.add(T.map_vals_col(base))
+                extra.add(base)  # a map EXPRESSION is named by its base
+        required = required | extra
         if isinstance(node, L.UnresolvedScan):
             # column-projection pushdown: the scan reads only what the
             # query needs (pushed filters are evaluated by the source

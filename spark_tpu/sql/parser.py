@@ -129,6 +129,11 @@ class Scope:
                     hits.append(out)
         if len(hits) > 1:
             raise SQLParseError(f"ambiguous column reference {name!r}")
+        if not hits and not name_l.endswith("#keys"):
+            # a MAP column decomposes into '<m>#keys'/'<m>#vals'
+            # (types.MapType); a bare reference resolves to the keys
+            # component, the canonical map handle
+            return self.resolve(qualifier, name + "#keys")
         return hits[0] if hits else None
 
     def all_output_names(self) -> List[str]:
@@ -317,6 +322,21 @@ class _ExprParser:
     # -- primaries -----------------------------------------------------------
 
     def parse_primary(self) -> E.Expression:
+        e = self._parse_primary_base()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value == "[":
+                # x[i]: 0-based array item / map key lookup (reference:
+                # GetArrayItem / GetMapValue, complexTypeExtractors.scala)
+                self.next()
+                key = self.parse()
+                self.expect("]")
+                e = E.ElementAt(e, key, sql_subscript=True)
+            else:
+                break
+        return e
+
+    def _parse_primary_base(self) -> E.Expression:
         t = self.next()
         if t.kind == "num":
             text = t.value
@@ -899,6 +919,10 @@ class _StmtParser:
         def resolve(qual: Optional[str], name: str) -> E.Expression:
             out = scope.resolve(qual, name)
             if out is not None:
+                if out.lower() == (name + "#keys").lower():
+                    # bare map reference: mark so the select list can
+                    # expand it to the '#keys'/'#vals' pair
+                    return E.MapHandle(out)
                 return E.Col(out)
             if self.outer is not None:
                 out2 = self.outer.resolve(qual, name)
@@ -1392,7 +1416,30 @@ class _StmtParser:
                     raise SQLParseError(
                         f"expected ',' in select list at "
                         f"{self.peek().pos}: {self.peek().value!r}")
-        return exprs
+        # a selected MAP handle ('m' resolved to Col('m#keys')) carries
+        # its '#vals' sibling so the pair survives projection
+        # (types.MapType decomposition)
+        out: List[E.Expression] = []
+        seen = {e.name for e in exprs}
+        for e in exprs:
+            out.append(e)
+            inner = E.strip_alias(e)
+            if isinstance(inner, E.MapHandle) \
+                    and inner.col_name.endswith("#keys"):
+                vals = inner.col_name[:-len("#keys")] + "#vals"
+                if isinstance(e, E.Alias):
+                    alias = e.alias_name
+                    if alias.endswith("#keys"):
+                        alias = alias[:-len("#keys")]
+                    out[-1] = E.Alias(inner, alias + "#keys")
+                    pair: E.Expression = E.Alias(E.Col(vals),
+                                                 alias + "#vals")
+                else:
+                    pair = E.Col(vals)
+                if pair.name not in seen:
+                    out.append(pair)
+                    seen.add(pair.name)
+        return out
 
 
 # ---- public entry points ----------------------------------------------------
@@ -1426,6 +1473,9 @@ def _composed_functions() -> dict:
         "ARRAY": F.array, "SIZE": F.size, "CARDINALITY": F.size,
         "ELEMENT_AT": F.element_at, "ARRAY_CONTAINS": F.array_contains,
         "EXPLODE": F.explode, "POSEXPLODE": F.posexplode,
+        "MAP": F.create_map, "MAP_FROM_ARRAYS": F.map_from_arrays,
+        "MAP_KEYS": F.map_keys, "MAP_VALUES": F.map_values,
+        "MAP_CONTAINS_KEY": F.map_contains_key,
     }
 
 

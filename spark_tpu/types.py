@@ -165,6 +165,50 @@ def array_len_col(name: str) -> str:
 
 
 @dataclass(frozen=True)
+class MapType(DataType):
+    """map<key, value>. Device layout: a map column DECOMPOSES at the
+    batch boundary into two parallel padded array columns,
+    '<col>#keys' (array<key>) and '<col>#vals' (array<value>), sharing
+    equal per-row lengths — the TPU-first answer to the reference's
+    ArrayBasedMapData (two ArrayData siblings inside one value,
+    reference: types/MapType.scala, ArrayBasedMapData.scala): static
+    shapes, and every row-level kernel handles the pair as ordinary
+    array columns with zero special cases. Lookups (element_at /
+    m[k]) are a vectorized key-match + take_along_axis over the pair.
+    Like the reference, maps are not orderable/groupable."""
+
+    key: DataType
+    value: DataType
+    np_dtype: Any = field(default=np.int64, compare=False, repr=False)
+
+    def __repr__(self) -> str:
+        return f"map<{self.key!r},{self.value!r}>"
+
+    def __hash__(self) -> int:
+        return hash((MapType, self.key, self.value))
+
+
+MAP_KEYS_SUFFIX = "#keys"
+MAP_VALS_SUFFIX = "#vals"
+
+
+def map_keys_col(name: str) -> str:
+    return name + MAP_KEYS_SUFFIX
+
+
+def map_vals_col(name: str) -> str:
+    return name + MAP_VALS_SUFFIX
+
+
+def map_base_name(name: str) -> Optional[str]:
+    """'m#keys'/'m#vals' -> 'm'; None for non-map-component names."""
+    for suffix in (MAP_KEYS_SUFFIX, MAP_VALS_SUFFIX):
+        if name.endswith(suffix):
+            return name[:-len(suffix)]
+    return None
+
+
+@dataclass(frozen=True)
 class StructType(DataType):
     """struct<...>. Structs FLATTEN at ingest into dotted columns
     ('s.f1', 's.f2' — reference peer: UnsafeRow nested struct access);
