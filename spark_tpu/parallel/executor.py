@@ -137,6 +137,45 @@ def _estimated_bytes(sb) -> int:
     return int(sb.capacity) * width
 
 
+def _decode_key_value(raw, field):
+    """Device key value -> python literal (host side of the hot-key
+    detection pass): dictionary codes decode to strings, dates/decimals
+    to their python types, everything else to plain ints/floats."""
+    if field.dictionary is not None:
+        code = int(raw)
+        return (field.dictionary[code]
+                if 0 <= code < len(field.dictionary) else None)
+    if isinstance(field.dtype, T.DateType):
+        return T.days_to_date(int(raw))
+    if isinstance(field.dtype, T.DecimalType):
+        import decimal
+
+        return decimal.Decimal(int(raw)).scaleb(-field.dtype.scale)
+    if hasattr(raw, "item"):
+        return raw.item()
+    return raw
+
+
+def _hot_key_pred(keys, hot) -> E.Expression:
+    """OR over hot candidates of AND(key == literal)."""
+    ors = None
+    for vals in hot:
+        ands = None
+        for k, v in zip(keys, vals):
+            c = E.Cmp("==", k, E.Literal(v))
+            ands = c if ands is None else E.And(ands, c)
+        ors = ands if ors is None else E.Or(ors, ands)
+    return ors
+
+
+def _null_any(keys) -> E.Expression:
+    out = None
+    for k in keys:
+        c = E.IsNull(k)
+        out = c if out is None else E.Or(out, c)
+    return out
+
+
 class MeshExecutor:
     """Plans and runs logical plans over a device mesh."""
 
@@ -457,17 +496,31 @@ class MeshExecutor:
                       and int(counts.max()) >= min_pairs
                       and float(counts.max()) > factor * max(1.0, med))
             if skewed and how in ("inner", "left", "left_semi",
-                                  "left_anti") \
-                    and _estimated_bytes(right0) <= self.conf.get(
-                        _conf.SKEW_MAX_BROADCAST_BYTES):
+                                  "left_anti"):
                 from spark_tpu import metrics
 
-                metrics.record(
-                    "skew_join_broadcast", max=int(counts.max()),
-                    median=med, factor=factor)
-                broadcast = True
-                left_sb, right_sb = left0, right0
-                counts = count_pairs(left_sb, right_sb, True)
+                if _estimated_bytes(right0) <= self.conf.get(
+                        _conf.SKEW_MAX_BROADCAST_BYTES):
+                    metrics.record(
+                        "skew_join_broadcast", max=int(counts.max()),
+                        median=med, factor=factor)
+                    broadcast = True
+                    left_sb, right_sb = left0, right0
+                    counts = count_pairs(left_sb, right_sb, True)
+                else:
+                    # build too big to broadcast whole: SPLIT around the
+                    # hot keys (reference: OptimizeSkewedJoin.scala:37
+                    # splits oversized partitions; here the hot keys'
+                    # probe rows stay row-sliced/balanced and only the
+                    # hot keys' FEW build rows replicate)
+                    hot = self._detect_hot_keys(jb.left_keys, left0)
+                    if hot:
+                        metrics.record(
+                            "skew_join_split", max=int(counts.max()),
+                            median=med, hot_keys=len(hot))
+                        return self._run_skew_split(
+                            jb, how, left0, right0, hot, union_dicts,
+                            mins, ranges, count_pairs)
             pair_cap = K.bucket(int(counts.max()) if counts.size else 0)
 
         left0 = right0 = None  # release pre-exchange device buffers
@@ -476,6 +529,88 @@ class MeshExecutor:
             jb.left_keys, jb.right_keys, jb.condition, mins, ranges,
             pair_cap, broadcast)
         return self._run_stage(apply_plan)
+
+    def _detect_hot_keys(self, keys, sb: ShardedBatch):
+        """Host-side hot-key candidates: each device reports its local
+        mode (TopKeyExec); a candidate is hot when its (lower-bound)
+        global count exceeds one balanced device share — the row volume
+        that would pile onto a single device under a hash exchange."""
+        cand = self._run_stage(D.TopKeyExec(tuple(keys),
+                                            D.ShardScanExec(sb)))
+        nkeys = len(keys)
+        fields = cand.schema.fields
+        cols = []
+        for i in range(nkeys + 1):
+            cd = cand.data.columns[i]
+            cols.append((np.asarray(cd.data).ravel(),
+                         None if cd.validity is None
+                         else np.asarray(cd.validity).ravel(),
+                         fields[i]))
+        counts: dict = {}
+        d = len(cols[0][0])
+        for j in range(d):
+            vals = []
+            ok = True
+            for i in range(nkeys):
+                data, validity, f = cols[i]
+                if validity is not None and not bool(validity[j]):
+                    ok = False  # null hot key: nulls never join
+                    break
+                vals.append(_decode_key_value(data[j], f))
+            if not ok:
+                continue
+            cnt = int(cols[nkeys][0][j])
+            key = tuple(vals)
+            counts[key] = counts.get(key, 0) + cnt
+        total = sb.num_valid_rows()
+        share = max(1, total // max(1, self.d))
+        hot = [k for k, c in sorted(counts.items(),
+                                    key=lambda kv: -kv[1]) if c > share]
+        return hot[:4]
+
+    def _run_skew_split(self, jb: D.DistJoinBoundary, how: str,
+                        left0: ShardedBatch, right0: ShardedBatch,
+                        hot, union_dicts, mins, ranges,
+                        count_pairs) -> ShardedBatch:
+        """AQE skew SPLIT: hot-key probe rows keep their balanced
+        row-sliced placement and join against a broadcast of (only) the
+        hot keys' build rows; everything else takes the normal hash
+        exchange. Union of the two joins is exact for left-preserved
+        join types — every probe row lands in exactly one branch and
+        sees ALL build rows with its key (the all_to_all analogue of
+        OptimizeSkewedJoin.scala:37 partition splitting)."""
+        lpred = _hot_key_pred(jb.left_keys, hot)
+        rpred = _hot_key_pred(jb.right_keys, hot)
+        # null probe keys must survive into the REST branch (preserved
+        # rows under outer/anti); NOT(pred) alone is NULL for them
+        lkeep_rest = E.Or(E.Not(lpred), _null_any(jb.left_keys))
+        rkeep_rest = E.Or(E.Not(rpred), _null_any(jb.right_keys))
+        lhot = self._run_stage(P.FilterExec(lpred, D.ShardScanExec(left0)))
+        lrest = self._run_stage(P.FilterExec(lkeep_rest,
+                                             D.ShardScanExec(left0)))
+        rhot = self._run_stage(P.FilterExec(rpred, D.ShardScanExec(right0)))
+        rrest = self._run_stage(P.FilterExec(rkeep_rest,
+                                             D.ShardScanExec(right0)))
+        lrest_ex = self.run(D.HashPartitionExchangeExec(
+            jb.left_keys, D.ShardScanExec(lrest),
+            key_union_dicts=union_dicts))
+        rrest_ex = self.run(D.HashPartitionExchangeExec(
+            jb.right_keys, D.ShardScanExec(rrest),
+            key_union_dicts=union_dicts))
+        c1 = count_pairs(lrest_ex, rrest_ex, False)
+        c2 = count_pairs(lhot, rhot, True)
+        cap1 = K.bucket(int(c1.max()) if c1.size else 0)
+        cap2 = K.bucket(int(c2.max()) if c2.size else 0)
+        j1 = self._run_stage(D.JoinApplyExec(
+            D.ShardScanExec(lrest_ex), D.ShardScanExec(rrest_ex), how,
+            jb.left_keys, jb.right_keys, jb.condition, mins, ranges,
+            cap1, broadcast=False))
+        j2 = self._run_stage(D.JoinApplyExec(
+            D.ShardScanExec(lhot), D.ShardScanExec(rhot), how,
+            jb.left_keys, jb.right_keys, jb.condition, mins, ranges,
+            cap2, broadcast=True))
+        return self._run_stage(P.UnionExec(D.ShardScanExec(j1),
+                                           D.ShardScanExec(j2)))
 
     def _run_cross(self, jb: D.DistJoinBoundary, left_sb: ShardedBatch,
                    right_sb: ShardedBatch) -> ShardedBatch:

@@ -576,6 +576,69 @@ def packed_join_keys(lpipe: Pipe, rpipe: Pipe,
 
 
 @dataclass(eq=False)
+class TopKeyExec(P.PhysicalPlan):
+    """Per-device heavy-hitter probe: the most frequent key tuple in
+    the device's local shard, with its local count (one output row per
+    device). The detection pass for AQE skew SPLIT — the reference
+    detects skew from shuffle-partition SIZES
+    (adaptive/OptimizeSkewedJoin.scala:37); here row distribution is
+    uniform by construction (row-sliced shards), so the hot KEY VALUE
+    is detected instead and the executor splits the join around it."""
+
+    keys: Tuple[E.Expression, ...]
+    child: P.PhysicalPlan
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        cs = self.child.schema
+        fields = []
+        for i, k in enumerate(self.keys):
+            inner = E.strip_alias(k)
+            dictionary = None
+            if isinstance(inner, E.Col) and inner.col_name in cs:
+                dictionary = cs.field(inner.col_name).dictionary
+            fields.append(Field(f"__hk{i}", k.data_type(cs), True,
+                                dictionary))
+        fields.append(Field("__cnt", T.INT64, nullable=False))
+        return Schema(tuple(fields))
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        cap = pipe.capacity
+        env = pipe.env()
+        key_tvs = [C.evaluate(k, env) for k in self.keys]
+        spipe, sorted_keys, seg, _ = P.sorted_groups(pipe, key_tvs)
+        cnt = K.seg_count(seg, spipe.mask, cap, sorted_seg=True)
+        best = jnp.argmax(cnt)
+        reps = P.first_group_keys(sorted_keys, seg, spipe.mask, cap, cap,
+                                  sorted_seg=True)
+        cols: Dict[str, TV] = {}
+        order = []
+        for i, tv in enumerate(reps):
+            nm = f"__hk{i}"
+            cols[nm] = TV(tv.data[best][None],
+                          None if tv.validity is None
+                          else tv.validity[best][None],
+                          tv.dtype, tv.dictionary)
+            order.append(nm)
+        cols["__cnt"] = TV(cnt[best][None].astype(jnp.int64), None,
+                           T.INT64, None)
+        order.append("__cnt")
+        return Pipe(cols, jnp.ones((1,), jnp.bool_), order)
+
+    def node_string(self):
+        return f"TopKey[{', '.join(map(str, self.keys))}]"
+
+    def plan_key(self):
+        return ("TopKey", tuple(E.expr_key(k) for k in self.keys),
+                self.child.plan_key())
+
+
+@dataclass(eq=False)
 class JoinCountExec(P.PhysicalPlan):
     """Stats pass: per-device equi-join match count (capacity sizing for
     JoinApplyExec). Output: one int64 per device."""

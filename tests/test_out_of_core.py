@@ -272,3 +272,96 @@ def test_chunked_topk(spark, join_parquet):
         spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
         spark.conf.unset("spark.tpu.chunkRows")
     assert got == want
+
+
+def test_skewed_join_split_non_broadcastable(spark):
+    """Build side over SKEW_MAX_BROADCAST_BYTES: the join SPLITS around
+    the hot key (hot probe rows stay row-sliced against a broadcast of
+    only the hot build rows) instead of inflating every device's pair
+    capacity (reference: OptimizeSkewedJoin.scala:37)."""
+    from spark_tpu import conf as _conf
+    from spark_tpu import metrics
+    from spark_tpu.expr import expressions as E
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+    from spark_tpu.plan import logical as L
+
+    n = 8000
+    fact = spark.createDataFrame(
+        [{"k": (1 if i % 10 else i % 400), "v": i} for i in range(n)])
+    dim = spark.createDataFrame(
+        [{"k": i, "w": i * 2} for i in range(400)])
+    plan = L.Aggregate(
+        (), (E.Alias(E.Count(None), "n"), E.Alias(E.Sum(E.Col("w")), "s")),
+        L.Join(fact._plan, dim._plan, "inner",
+               (E.Col("k"),), (E.Col("k"),)))
+    conf = _conf.RuntimeConf()
+    conf.set("spark.tpu.skewJoin.maxBroadcastBytes", 1)  # no demotion
+    conf.set("spark.tpu.skewJoin.minPairs", 1000)
+    ex = MeshExecutor(make_mesh(8), broadcast_threshold=1, conf=conf)
+    metrics.reset()
+    r = ex.execute_logical(plan).to_pylist()[0]
+    evs = [e for e in metrics.recent(500) if e["kind"] == "skew_join_split"]
+    assert evs, "split path did not engage"
+    assert evs[-1]["hot_keys"] >= 1
+    assert r["n"] == n
+    want_s = sum((1 if i % 10 else i % 400) * 2 for i in range(n))
+    assert r["s"] == want_s
+
+
+def test_skewed_left_join_split_parity(spark):
+    """Split preserves left-outer semantics: unmatched and NULL probe
+    keys survive through the REST branch."""
+    from spark_tpu import conf as _conf
+    from spark_tpu import metrics
+    from spark_tpu.expr import expressions as E
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+    from spark_tpu.plan import logical as L
+
+    import pyarrow as pa
+    import numpy as np
+
+    n = 6000
+    ks = np.array([(7 if i % 5 else i % 900) for i in range(n)],
+                  dtype=np.int64)
+    fact = pa.table({
+        "k": pa.array(ks, pa.int64()),
+        "v": pa.array(np.arange(n), pa.int64()),
+    })
+    # every 97th key is NULL
+    kmask = np.arange(n) % 97 == 0
+    fact = fact.set_column(0, "k", pa.array(
+        np.where(kmask, 0, ks), pa.int64(), mask=kmask))
+    dim = spark.createDataFrame(
+        [{"k": i, "w": i * 3} for i in range(0, 500)])  # 500..899 unmatched
+    fdf = spark.createDataFrame(fact)
+    plan = L.Aggregate(
+        (), (E.Alias(E.Count(None), "n"),
+             E.Alias(E.Count(E.Col("w")), "m"),
+             E.Alias(E.Sum(E.Col("w")), "s")),
+        L.Join(fdf._plan, dim._plan, "left",
+               (E.Col("k"),), (E.Col("k"),)))
+    conf = _conf.RuntimeConf()
+    conf.set("spark.tpu.skewJoin.maxBroadcastBytes", 1)
+    conf.set("spark.tpu.skewJoin.minPairs", 1000)
+    ex = MeshExecutor(make_mesh(8), broadcast_threshold=1, conf=conf)
+    metrics.reset()
+    r = ex.execute_logical(plan).to_pylist()[0]
+    assert [e for e in metrics.recent(500)
+            if e["kind"] == "skew_join_split"]
+    # oracle
+    want_n = want_m = 0
+    want_s = 0
+    for i in range(n):
+        if i % 97 == 0:
+            want_n += 1  # null key: left row kept, no match
+            continue
+        k = 7 if i % 5 else i % 900
+        if k < 500:
+            want_n += 1
+            want_m += 1
+            want_s += k * 3
+        else:
+            want_n += 1
+    assert (r["n"], r["m"], r["s"]) == (want_n, want_m, want_s)
