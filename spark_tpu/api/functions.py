@@ -45,6 +45,13 @@ def window(c: ColumnOrName, width: int) -> Column:
     return E.TumblingWindow(_c(c), int(width))
 
 
+def session_window(c: ColumnOrName, gap: int) -> Column:
+    """Gap-based session window grouping for streaming aggregation; the
+    produced column is the merged session START (reference:
+    functions.session_window, MergingSessionsExec)."""
+    return E.SessionWindow(_c(c), int(gap))
+
+
 # ---- window functions ------------------------------------------------------
 
 
@@ -585,17 +592,26 @@ def _map_base(c: ColumnOrName) -> str:
         name = c.col_name
     else:
         raise TypeError(
-            "map accessors need a map COLUMN reference (maps are "
-            "decomposed into component columns — types.MapType)")
+            "map accessors need a map column reference or an inline "
+            "map() expression (maps are decomposed into component "
+            "columns — types.MapType)")
     base = T.map_base_name(name)
     return base if base is not None else name
 
 
 def map_keys(c: ColumnOrName) -> Column:
+    if isinstance(c, E.CreateMap):  # inline map(): pure rewrite
+        return E.MakeArray(c.args[::2])
+    if isinstance(c, E.MapFromArrays):
+        return c.keys
     return E.Col(T.map_keys_col(_map_base(c)))
 
 
 def map_values(c: ColumnOrName) -> Column:
+    if isinstance(c, E.CreateMap):
+        return E.MakeArray(c.args[1::2])
+    if isinstance(c, E.MapFromArrays):
+        return c.vals
     return E.Col(T.map_vals_col(_map_base(c)))
 
 
@@ -675,3 +691,10 @@ def explode(c: ColumnOrName) -> Column:
 
 def posexplode(c: ColumnOrName) -> Column:
     return E.Explode(_c(c), with_position=True)
+
+
+def replace(c: ColumnOrName, find: str, replacement: str) -> Column:
+    """Literal substring replacement (reference: StringReplace)."""
+    import re as _re
+
+    return E.RegexpReplace(_c(c), _re.escape(str(find)), str(replacement))

@@ -341,14 +341,14 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
 
     if isinstance(expr, E.MakeArray):
         tvs = [evaluate(a, env) for a in expr.args]
-        if any(t.validity is not None for t in tvs):
-            # null ELEMENTS inside arrays are not representable in the
-            # padded layout (types.ArrayType) — Spark's CreateArray
-            # would keep [1, NULL]; silently nulling the whole array
-            # gives wrong size()/element_at results, so refuse loudly
-            raise NotImplementedError(
-                "array() over nullable inputs: null elements are not "
-                "representable — coalesce() the inputs first")
+        # null ELEMENTS inside arrays are not representable in the
+        # padded layout (types.ArrayType) — Spark's CreateArray would
+        # keep [1, NULL]; here a null input nulls the WHOLE array row
+        # (documented ArrayType deviation, PARITY.md): size()/
+        # element_at() then see a null array, never a wrong length
+        validity = None
+        for t in tvs:
+            validity = _and_validity(validity, t.validity)
         el = tvs[0].dtype
         for t in tvs[1:]:
             el = T.common_type(el, t.dtype)
@@ -363,7 +363,9 @@ def evaluate(expr: E.Expression, env: Env) -> TV:
             dictionary = None
         data = jnp.stack(cols, axis=1)
         lengths = jnp.full((n,), len(tvs), dtype=jnp.int32)
-        return TV(data, None, T.ArrayType(el), dictionary, lengths)
+        if validity is not None:
+            lengths = jnp.where(validity, lengths, 0)
+        return TV(data, validity, T.ArrayType(el), dictionary, lengths)
 
     if isinstance(expr, E.Split):
         tv = evaluate(expr.child, env)
@@ -962,6 +964,52 @@ def _eval_cmp(expr: E.Cmp, env: Env) -> TV:
     rt = evaluate(expr.right, env)
     valid = _and_validity(lt.validity, rt.validity)
 
+    # date/timestamp vs string: the string side coerces to the temporal
+    # type via its dictionary (reference: DateTimeUtils / implicit cast
+    # in BinaryComparison type coercion) — 'YYYY-MM-DD' literals and
+    # columns compare as days/micros, not lexicographically
+    def _temporal_coerce(tv: TV, other_dt) -> TV:
+        if not isinstance(tv.dtype, T.StringType):
+            return tv
+        entries = tv.dictionary or ()
+        if isinstance(other_dt, T.DateType):
+            parsed = [_parse_date_days(s) for s in entries]
+            vals = np.array([v if v is not None else 0 for v in parsed]
+                            or [0], dtype=np.int32)
+            ok_tab = np.array([v is not None for v in parsed] or [False])
+            data = jnp.asarray(vals)[tv.data] if len(entries) \
+                else tv.data.astype(jnp.int32)
+            ok = jnp.asarray(ok_tab)[tv.data] if len(entries) \
+                else jnp.zeros((n,), jnp.bool_)
+            return TV(data, _and_validity(tv.validity, ok),
+                      T.DATE, None)
+        if isinstance(other_dt, T.TimestampType):
+            vals, ok_tab = [], []
+            for s in entries:
+                try:
+                    dtv = datetime.datetime.fromisoformat(s)
+                    vals.append(int(dtv.timestamp() * 1_000_000))
+                    ok_tab.append(True)
+                except ValueError:
+                    vals.append(0)
+                    ok_tab.append(False)
+            data = jnp.asarray(np.array(vals or [0], np.int64))[tv.data] \
+                if len(entries) else tv.data.astype(jnp.int64)
+            ok = jnp.asarray(np.array(ok_tab or [False]))[tv.data] \
+                if len(entries) else jnp.zeros((n,), jnp.bool_)
+            return TV(data, _and_validity(tv.validity, ok),
+                      T.TIMESTAMP, None)
+        return tv
+
+    if isinstance(lt.dtype, (T.DateType, T.TimestampType)) \
+            and isinstance(rt.dtype, T.StringType):
+        rt = _temporal_coerce(rt, lt.dtype)
+        valid = _and_validity(lt.validity, rt.validity)
+    elif isinstance(rt.dtype, (T.DateType, T.TimestampType)) \
+            and isinstance(lt.dtype, T.StringType):
+        lt = _temporal_coerce(lt, rt.dtype)
+        valid = _and_validity(lt.validity, rt.validity)
+
     if isinstance(lt.dtype, T.StringType) or isinstance(rt.dtype, T.StringType):
         data = _string_cmp_tables(lt, rt, expr.op, n)
         return TV(data, valid, T.BOOLEAN, None)
@@ -1169,7 +1217,14 @@ def _eval_array_aggregate(expr: "E.HigherOrder", tv: TV, lens, env: Env,
 
 def _map_pair(child: "E.Expression", env: Env):
     """(keys TV, vals TV) when ``child`` references a decomposed MAP
-    column (types.MapType); None otherwise."""
+    column or is an inline map expression (types.MapType); None
+    otherwise."""
+    child = E.strip_alias(child)
+    if isinstance(child, E.CreateMap):
+        return (evaluate(E.MakeArray(child.args[::2]), env),
+                evaluate(E.MakeArray(child.args[1::2]), env))
+    if isinstance(child, E.MapFromArrays):
+        return (evaluate(child.keys, env), evaluate(child.vals, env))
     if not isinstance(child, E.Col):
         return None
     nm = child.col_name
@@ -1234,3 +1289,11 @@ def evaluate_map_pair(expr: "E.Expression", env: Env):
     if pair is not None:
         return pair
     raise NotImplementedError(f"not a map-typed expression: {expr}")
+
+
+def _parse_date_days(s: str):
+    """ISO date string -> days since epoch; None when unparseable."""
+    try:
+        return T.date_to_days(datetime.date.fromisoformat(s.strip()))
+    except ValueError:
+        return None

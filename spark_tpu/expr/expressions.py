@@ -486,6 +486,8 @@ class ElementAt(Expression):
         dt = self.child.data_type(schema)
         if isinstance(dt, T.ArrayType):
             return dt.element
+        if isinstance(dt, T.MapType):
+            return dt.value
         raise TypeError(f"element_at over non-array {dt!r}")
 
     def nullable(self, schema):
@@ -510,6 +512,27 @@ class ArrayContains(Expression):
 
     def __str__(self):
         return f"array_contains({self.child}, {self.value})"
+
+
+@dataclass(eq=False, frozen=True)
+class TupleExpr(Expression):
+    """(a, b, ...) row-value constructor — only legal as the probe of a
+    multi-column IN (subquery) (reference: In.scala accepts
+    CreateStruct probes; the subquery rewrite expands it to a
+    multi-key semi join)."""
+
+    items: Tuple[Expression, ...]
+
+    def children(self):
+        return self.items
+
+    def data_type(self, schema):
+        raise TypeError(
+            "a row-value (a, b) is only valid as the probe of a "
+            "multi-column IN (subquery)")
+
+    def __str__(self):
+        return "(" + ", ".join(str(i) for i in self.items) + ")"
 
 
 @dataclass(eq=False, frozen=True)
@@ -1277,6 +1300,32 @@ class TumblingWindow(Expression):
     def as_arith(self) -> Expression:
         return Arith("-", self.child,
                      Arith("%", self.child, Literal(self.width)))
+
+
+@dataclass(eq=False, frozen=True)
+class SessionWindow(Expression):
+    """Gap-based session window key (reference:
+    expressions/SessionWindow.scala; planned by MergingSessionsExec).
+    The streaming runner keys partial aggregates by the raw event time
+    (each event opens a provisional [t, t+gap) session) and merges
+    overlapping sessions in the state-merge step; the grouping output
+    is the merged session START."""
+
+    child: Expression
+    gap: int
+
+    def children(self):
+        return (self.child,)
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name(self):
+        return "session_window"
+
+    def __str__(self):
+        return f"session_window({self.child}, {self.gap})"
 
 
 def window_dictionary(w: "WindowExpr", schema) -> Optional[tuple]:

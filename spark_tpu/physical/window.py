@@ -388,12 +388,39 @@ class WindowExec(P.PhysicalPlan):
             # prefix covers whole-partition too (hi = seg_end there);
             # scatter-based segment_min/max is never worth it (kernels.py)
             prefix = w.frame is None or w.frame[1] is None
-            if not prefix:
+            if prefix:
+                scan = _seg_scan_min if is_min else _seg_scan_max
+                run = scan(seg, masked)
+                out = run[hic]  # hi is peer_last/seg_end: runs forward
+                return out, cnt > 0, tv.dictionary
+            # bounded frame: SPARSE-TABLE range min/max — log2(cap)
+            # doubling-window levels, then each row's [lo, hi] answers
+            # as the min of two overlapping power-of-two windows
+            # (O(n log n) build fully vectorized; the reference walks
+            # each frame row-by-row, WindowExec SlidingWindowFunctionFrame)
+            if cap > (1 << 22):
                 raise NotImplementedError(
-                    "sliding min/max window frames are not supported")
-            scan = _seg_scan_min if is_min else _seg_scan_max
-            run = scan(seg, masked)
-            out = run[hic]  # hi is peer_last/seg_end: runs forward
+                    "sliding min/max over > 4M-row batches (sparse "
+                    "table would exceed the window memory budget)")
+            import math as _math
+
+            levels = max(1, _math.ceil(_math.log2(max(2, cap))))
+            combine = jnp.minimum if is_min else jnp.maximum
+            tabs = [masked]
+            for k in range(1, levels + 1):
+                half = 1 << (k - 1)
+                prev = tabs[-1]
+                shifted = jnp.concatenate(
+                    [prev[half:], jnp.full((half,), sent, prev.dtype)])
+                tabs.append(combine(prev, shifted))
+            stacked = jnp.stack(tabs)  # (levels+1, cap)
+            length = jnp.maximum(hic - lo + 1, 1).astype(jnp.int64)
+            kk = (63 - jax.lax.clz(length)).astype(jnp.int32)
+            kk = jnp.clip(kk, 0, levels)
+            span = jnp.left_shift(jnp.ones((), jnp.int64), kk)
+            a = stacked[kk, jnp.clip(lo, 0, cap - 1)]
+            b = stacked[kk, jnp.clip(hic - span + 1, 0, cap - 1)]
+            out = combine(a, b)
             return out, cnt > 0, tv.dictionary
         raise NotImplementedError(f"window aggregate {fn}")
 

@@ -198,9 +198,24 @@ def _apply_in(plan: L.LogicalPlan, isq: E.InSubquery) -> L.LogicalPlan:
         raise NotImplementedError(
             "correlated predicate below an aggregate inside IN subquery")
     outer_keys, inner_keys, residual = _corr_to_keys(corr)
-    value_col = stripped.schema.names[0]
-    outer_keys = [isq.child] + outer_keys
-    inner_keys = [E.Col(value_col)] + inner_keys
+    if isinstance(isq.child, E.TupleExpr):
+        # (a, b) IN (select x, y ...): multi-key semi join (reference:
+        # In.scala with a CreateStruct probe)
+        probes = list(isq.child.items)
+        if isq.negated:
+            raise NotImplementedError(
+                "NOT IN with a row-value probe (null-aware anti join "
+                "over multiple columns)")
+        if len(probes) > len(stripped.schema.names):
+            raise ValueError("IN subquery arity mismatch")
+        value_cols = [E.Col(n)
+                      for n in stripped.schema.names[:len(probes)]]
+        outer_keys = probes + outer_keys
+        inner_keys = value_cols + inner_keys
+    else:
+        value_col = stripped.schema.names[0]
+        outer_keys = [isq.child] + outer_keys
+        inner_keys = [E.Col(value_col)] + inner_keys
     cond = _join_condition(residual, plan.schema.names,
                            stripped.schema.names)
     how = "left_anti" if isq.negated else "left_semi"

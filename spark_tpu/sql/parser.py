@@ -351,6 +351,12 @@ class _ExprParser:
                 self.expect(")")
                 return E.ScalarSubquery(plan)
             e = self.parse()
+            if self.peek().kind == "op" and self.peek().value == ",":
+                items = [e]
+                while self.accept(","):
+                    items.append(self.parse())
+                self.expect(")")
+                return E.TupleExpr(tuple(items))
             self.expect(")")
             return e
         if t.kind in ("id", "qid"):
@@ -582,8 +588,10 @@ class _ExprParser:
             else:
                 self.expect(",")
                 pos = self._int_literal()
-                self.expect(",")
-                length = self._int_literal()
+                if self.accept(","):
+                    length = self._int_literal()
+                else:  # substr(s, pos): to the end of the string
+                    length = 1 << 30
             self.expect(")")
             return E.Substring(e, pos, length)
         if name == "COALESCE":
@@ -707,6 +715,16 @@ class _ExprParser:
             nrep = self._int_literal()
             self.expect(")")
             return E.StringTransform("repeat", e, (nrep,))
+        if name == "REPLACE":
+            e = self.parse()
+            self.expect(",")
+            find = self._str_literal()
+            self.expect(",")
+            repl = self._str_literal()
+            self.expect(")")
+            import re as _re
+
+            return E.RegexpReplace(e, _re.escape(find), repl)
         if name == "TRANSLATE":
             e = self.parse()
             self.expect(",")
@@ -1115,14 +1133,14 @@ class _StmtParser:
                     plan = L.Distinct(plan)
             elif self.accept("INTERSECT"):
                 rhs = self.parse_select_core()
-                cols = tuple(E.Col(n) for n in plan.schema.names)
-                rcols = tuple(E.Col(n) for n in rhs.schema.names)
+                cols = _null_safe_setop_keys(plan)
+                rcols = _null_safe_setop_keys(rhs)
                 plan = L.Distinct(
                     L.Join(plan, rhs, "left_semi", cols, rcols))
             elif self.accept("EXCEPT"):
                 rhs = self.parse_select_core()
-                cols = tuple(E.Col(n) for n in plan.schema.names)
-                rcols = tuple(E.Col(n) for n in rhs.schema.names)
+                cols = _null_safe_setop_keys(plan)
+                rcols = _null_safe_setop_keys(rhs)
                 plan = L.Distinct(
                     L.Join(plan, rhs, "left_anti", cols, rcols))
             else:
@@ -1135,19 +1153,33 @@ class _StmtParser:
             self.next()
             self.expect("BY")
             out_names = set(plan.schema.names)
+            # ORDER BY may reference projection INPUT columns that the
+            # select list dropped (reference: Analyzer
+            # ResolveSortReferences — the sort sees a widened Project,
+            # then the extra columns are projected away again)
+            hidden: set = set()
+            child_names = (set(plan.child.schema.names)
+                           if isinstance(plan, L.Project) else set())
 
             def resolve(qual, name):
-                if name in out_names or qual is None:
-                    if name not in out_names:
-                        # case-insensitive fallback
-                        for n in out_names:
-                            if n.lower() == name.lower():
-                                return E.Col(n)
-                        raise SQLParseError(
-                            f"ORDER BY column {name!r} is not in the "
-                            f"select list output {sorted(out_names)}")
+                # ORDER BY resolves against the select OUTPUT; a
+                # qualifier (t1.a) is dropped — the output columns of a
+                # join carry deduplicated bare names, so the bare name
+                # identifies the column (ambiguity already got a _N
+                # suffix at join time)
+                if name in out_names:
                     return E.Col(name)
-                raise SQLParseError(f"cannot resolve {qual}.{name}")
+                for n in out_names:  # case-insensitive fallback
+                    if n.lower() == name.lower():
+                        return E.Col(n)
+                for n in child_names:
+                    if n.lower() == name.lower():
+                        hidden.add(n)
+                        return E.Col(n)
+                raise SQLParseError(
+                    f"ORDER BY column "
+                    f"{(qual + '.' if qual else '') + name!r} is not in "
+                    f"the select list output {sorted(out_names)}")
 
             orders = []
             while True:
@@ -1166,7 +1198,17 @@ class _StmtParser:
                 orders.append(E.SortOrder(e, asc, nulls_first))
                 if not self.accept(","):
                     break
-            plan = L.Sort(tuple(orders), plan)
+            if hidden:
+                visible = tuple(plan.schema.names)
+                widened = L.Project(
+                    tuple(plan.exprs)
+                    + tuple(E.Col(n) for n in sorted(hidden)
+                            if n not in out_names),
+                    plan.child)
+                plan = L.Project(tuple(E.Col(n) for n in visible),
+                                 L.Sort(tuple(orders), widened))
+            else:
+                plan = L.Sort(tuple(orders), plan)
         if self.at_keyword("LIMIT"):
             self.next()
             n = int(self.next().value)
@@ -1443,6 +1485,40 @@ class _StmtParser:
 
 
 # ---- public entry points ----------------------------------------------------
+
+
+def _null_safe_setop_keys(plan) -> tuple:
+    """INTERSECT/EXCEPT compare rows with NULL-SAFE equality (SQL set
+    semantics: NULL equals NULL — the reference plans these as
+    null-aware joins, ReplaceIntersectWithSemiJoin + EqualNullSafe).
+    Each nullable column becomes TWO join keys: a typed
+    coalesce-to-zero payload and an is-null flag."""
+    import datetime as _dt
+    import decimal as _decimal
+
+    from spark_tpu import types as T
+
+    keys = []
+    for f in plan.schema.fields:
+        c = E.Col(f.name)
+        if not f.nullable:
+            keys.append(c)
+            continue
+        dt = f.dtype
+        zero: object = 0
+        if isinstance(dt, T.StringType):
+            zero = ""
+        elif isinstance(dt, T.DateType):
+            zero = _dt.date(1970, 1, 1)
+        elif isinstance(dt, (T.Float32Type, T.Float64Type)):
+            zero = 0.0
+        elif isinstance(dt, T.DecimalType):
+            zero = _decimal.Decimal(0)
+        elif isinstance(dt, T.BooleanType):
+            zero = False
+        keys.append(E.Coalesce((c, E.Literal(zero))))
+        keys.append(E.IsNull(c))
+    return tuple(keys)
 
 
 class _NoCatalog:

@@ -1,0 +1,220 @@
+"""Golden-file SQL harness (reference: SQLQueryTestSuite.scala:133 over
+sql/core/src/test/resources/sql-tests/inputs/*.sql with checked-in
+golden results).
+
+Input files live in ``inputs/*.sql`` — semicolon-separated statements,
+``--`` comments. A file whose FIRST line is ``-- oracle: engine`` is an
+engine-regression lock (features sqlite lacks: grouping sets, arrays,
+maps, higher-order functions — the reference's goldens are likewise
+self-generated); every other file's goldens come from the INDEPENDENT
+sqlite oracle, so dialect semantics (null ordering, three-valued logic,
+set-op corners, window frames) are cross-checked against a second
+implementation.
+
+Golden format (``goldens/<name>.out``)::
+
+    -- !query
+    select ...
+    -- !results
+    1|NULL|x
+    ...
+
+Regenerate with ``python -m tests.sql_golden.regen`` from the repo
+root. Queries must be DETERMINISTIC (ORDER BY everything or be a single
+aggregate row); the harness additionally sorts rows defensively so an
+ambiguous tie cannot flake.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import os
+import sqlite3
+from typing import List, Tuple
+
+HERE = os.path.dirname(__file__)
+INPUTS = os.path.join(HERE, "inputs")
+GOLDENS = os.path.join(HERE, "goldens")
+
+# ---- shared base tables ------------------------------------------------------
+#
+# Small, null-riddled, duplicate-riddled tables both engines build
+# identically. Dates are ISO strings in sqlite (its native convention)
+# and DATE columns in the engine; both print identically.
+
+T1_ROWS = [
+    # (a, b, c, s)
+    (1, 10, 1.5, "apple"),
+    (1, 20, -2.25, "banana"),
+    (2, 10, 0.0, "apple"),
+    (2, None, 3.5, None),
+    (3, 30, None, "cherry"),
+    (None, 40, 7.25, "banana"),
+    (None, None, None, None),
+    (4, 10, 2.5, "date"),
+    (4, 40, 2.5, "apple"),
+    (5, 50, -1.0, "elder"),
+    (2, 20, 4.75, "fig"),
+    (3, 10, 1.25, "grape"),
+]
+
+T2_ROWS = [
+    # (a, d, t)
+    (1, 100, "x"),
+    (2, 200, "y"),
+    (2, 201, "y"),
+    (6, 600, "z"),
+    (None, 700, "w"),
+    (4, None, "x"),
+]
+
+EMP_ROWS = [
+    # (id, name, dept, salary, hired)
+    (1, "alice", "eng", 100.0, "2020-01-15"),
+    (2, "bob", "eng", 90.0, "2021-03-01"),
+    (3, "carol", "sales", 80.0, "2019-07-30"),
+    (4, "dan", "sales", 80.0, "2022-11-11"),
+    (5, "erin", "hr", 70.0, "2020-06-01"),
+    (6, "frank", None, 60.0, "2023-02-28"),
+    (7, "grace", "eng", None, "2021-09-09"),
+]
+
+
+def setup_sqlite() -> sqlite3.Connection:
+    conn = sqlite3.connect(":memory:")
+    conn.execute("create table t1(a integer, b integer, c real, s text)")
+    conn.executemany("insert into t1 values (?,?,?,?)", T1_ROWS)
+    conn.execute("create table t2(a integer, d integer, t text)")
+    conn.executemany("insert into t2 values (?,?,?)", T2_ROWS)
+    conn.execute("create table emp(id integer, name text, dept text, "
+                 "salary real, hired text)")
+    conn.executemany("insert into emp values (?,?,?,?,?)", EMP_ROWS)
+    conn.commit()
+    return conn
+
+
+def setup_engine(spark) -> None:
+    import pyarrow as pa
+
+    def col(rows, i, typ):
+        return pa.array([r[i] for r in rows], typ)
+
+    t1 = pa.table({"a": col(T1_ROWS, 0, pa.int64()),
+                   "b": col(T1_ROWS, 1, pa.int64()),
+                   "c": col(T1_ROWS, 2, pa.float64()),
+                   "s": col(T1_ROWS, 3, pa.string())})
+    t2 = pa.table({"a": col(T2_ROWS, 0, pa.int64()),
+                   "d": col(T2_ROWS, 1, pa.int64()),
+                   "t": col(T2_ROWS, 2, pa.string())})
+    emp = pa.table({
+        "id": col(EMP_ROWS, 0, pa.int64()),
+        "name": col(EMP_ROWS, 1, pa.string()),
+        "dept": col(EMP_ROWS, 2, pa.string()),
+        "salary": col(EMP_ROWS, 3, pa.float64()),
+        "hired": pa.array([datetime.date.fromisoformat(r[4])
+                           for r in EMP_ROWS], pa.date32()),
+    })
+    spark.createDataFrame(t1).createOrReplaceTempView("t1")
+    spark.createDataFrame(t2).createOrReplaceTempView("t2")
+    spark.createDataFrame(emp).createOrReplaceTempView("emp")
+
+
+# ---- normalization -----------------------------------------------------------
+
+
+def norm_value(v) -> str:
+    """One canonical text form both engines map onto: the golden file
+    currency."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"  # sqlite's boolean surface
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return f"{v:.6g}"
+    if isinstance(v, decimal.Decimal):
+        f = float(v)
+        return str(int(f)) if f == int(f) else f"{f:.6g}"
+    if isinstance(v, datetime.datetime):
+        return v.isoformat(sep=" ")
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(norm_value(x) for x in v) + "]"
+    if isinstance(v, dict):
+        items = sorted(v.items(), key=lambda kv: str(kv[0]))
+        return "{" + ",".join(f"{norm_value(k)}:{norm_value(x)}"
+                              for k, x in items) + "}"
+    return str(v)
+
+
+def norm_rows(rows: List[tuple]) -> List[str]:
+    out = ["|".join(norm_value(v) for v in row) for row in rows]
+    return sorted(out)  # defensive: ties must not flake
+
+
+# ---- execution ---------------------------------------------------------------
+
+
+def run_sqlite(conn: sqlite3.Connection, sql: str) -> List[str]:
+    return norm_rows([tuple(r) for r in conn.execute(sql).fetchall()])
+
+
+def run_engine(spark, sql: str) -> List[str]:
+    rows = spark.sql(sql).collect()
+    return norm_rows([tuple(r.asDict().values()) for r in rows])
+
+
+# ---- file formats ------------------------------------------------------------
+
+
+def parse_input(path: str) -> Tuple[str, List[str]]:
+    """Returns (oracle, statements)."""
+    with open(path) as f:
+        text = f.read()
+    oracle = "sqlite"
+    lines = text.splitlines()
+    if lines and lines[0].strip().lower().startswith("-- oracle:"):
+        oracle = lines[0].split(":", 1)[1].strip()
+    body = "\n".join(ln for ln in lines
+                     if not ln.strip().startswith("--"))
+    stmts = [s.strip() for s in body.split(";") if s.strip()]
+    return oracle, stmts
+
+
+def read_golden(path: str) -> List[Tuple[str, List[str]]]:
+    out = []
+    query: List[str] = []
+    results: List[str] = []
+    mode = None
+    with open(path) as f:
+        for line in f.read().splitlines():
+            if line == "-- !query":
+                if mode == "results":
+                    out.append(("\n".join(query), results))
+                query, results, mode = [], [], "query"
+            elif line == "-- !results":
+                mode = "results"
+            elif mode == "query":
+                query.append(line)
+            elif mode == "results":
+                results.append(line)
+    if mode == "results":
+        out.append(("\n".join(query), results))
+    return out
+
+
+def write_golden(path: str, entries: List[Tuple[str, List[str]]]) -> None:
+    with open(path, "w") as f:
+        for sql, rows in entries:
+            f.write("-- !query\n")
+            f.write(sql + "\n")
+            f.write("-- !results\n")
+            for r in rows:
+                f.write(r + "\n")
+
+
+def input_files() -> List[str]:
+    return sorted(f for f in os.listdir(INPUTS) if f.endswith(".sql"))

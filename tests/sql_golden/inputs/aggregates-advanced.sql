@@ -1,0 +1,7 @@
+-- oracle: engine
+-- stddev family, exact percentiles, collection aggregates
+select round(stddev(b), 4), round(var_pop(b), 4) from t1;
+select a, percentile_approx(b, 0.5), median(b) from t1 group by a order by a nulls first;
+select a, collect_list(b) from t1 where b is not null group by a order by a nulls first;
+select a, collect_set(s) from t1 group by a order by a nulls first;
+select percentile(b, 0.25) from t1;

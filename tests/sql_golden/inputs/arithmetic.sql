@@ -1,0 +1,8 @@
+-- arithmetic corners with an independent oracle: float division,
+-- remainder sign, abs/round, unary minus
+select a, b, a * 1.0 / b from t1 where b is not null and b != 0 order by a nulls first, b;
+select b % 7, -b from t1 where b is not null order by b, b % 7;
+select abs(c), round(c) from t1 where c is not null order by c;
+select round(c, 1) from t1 where c is not null order by c;
+select max(b) - min(b), sum(b) * 1.0 / count(b) from t1;
+select a + 0.5, a - 0.5 from t1 where a is not null order by a;

@@ -1,0 +1,6 @@
+-- oracle: engine
+-- array construction / access / explode (regression lock)
+select array(a, b) from t1 where a is not null and b is not null order by a, b;
+select size(array(1, 2, 3)), element_at(array(10, 20), 2), array(5, 6)[0];
+select array_contains(array(a, b), 10) from t1 where a is not null and b is not null order by a, b;
+select a, x from t1 lateral view explode(array(b, b + 1)) v as x where a = 1 order by a, b, x;

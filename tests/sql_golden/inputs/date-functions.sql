@@ -1,0 +1,6 @@
+-- oracle: engine
+-- engine date function surface (sqlite spells these differently)
+select id, year(hired), month(hired), day(hired) from emp order by id;
+select id, date_add(hired, 30) from emp order by id;
+select dept, min(hired), max(hired) from emp group by dept order by dept nulls first;
+select id from emp where year(hired) = 2021 order by id;

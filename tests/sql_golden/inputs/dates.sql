@@ -1,0 +1,6 @@
+-- date columns order/compare/group; both engines print ISO dates
+select id, hired from emp order by hired, id;
+select id from emp where hired >= '2021-01-01' order by id;
+select max(hired), min(hired) from emp;
+select dept, max(hired) from emp group by dept order by dept nulls first;
+select id, hired from emp where hired between '2020-01-01' and '2021-12-31' order by id;

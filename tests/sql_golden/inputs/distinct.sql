@@ -1,0 +1,6 @@
+-- DISTINCT corners: nulls collapse, multi-column
+select distinct s from t1 order by s nulls first;
+select distinct a, b from t1 order by a nulls first, b nulls first;
+select count(distinct b) from t1;
+select distinct a % 2 from t1 order by 1 nulls first;
+select distinct t1.a from t1 join t2 on t1.a = t2.a order by t1.a;

@@ -1,0 +1,10 @@
+-- grouped aggregation corners: null keys group together, having,
+-- expression keys, distinct aggregates (reference input: group-by.sql)
+select a, count(*), count(b), sum(b), min(b), max(b) from t1 group by a order by a nulls first;
+select a, avg(c) from t1 group by a having count(*) > 1 order by a nulls first;
+select a % 2, sum(b) from t1 where a is not null group by a % 2 order by 1;
+select count(distinct s), count(distinct a) from t1;
+select s, count(distinct a) from t1 group by s order by s nulls first;
+select count(*) from t1;
+select sum(b), avg(b * 1.0), min(c), max(c) from t1;
+select a, b, count(*) from t1 group by a, b order by a nulls first, b nulls first;

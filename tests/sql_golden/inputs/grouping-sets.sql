@@ -1,0 +1,6 @@
+-- oracle: engine
+-- grouping sets / rollup / cube (sqlite lacks them; regression lock,
+-- reference input: grouping_set.sql, group-analytics.sql)
+select a, s, count(*) from t1 group by grouping sets ((a), (s)) order by a nulls first, s nulls first;
+select a, s, sum(b), grouping(a), grouping(s) from t1 group by rollup (a, s) order by a nulls first, s nulls first, 3 nulls first;
+select a, s, count(*) from t1 group by cube (a, s) order by a nulls first, s nulls first, 3;

@@ -1,0 +1,7 @@
+-- oracle: engine
+-- lambdas over arrays (regression lock; reference: higherOrderFunctions)
+select transform(array(a, b), x -> x * 10) from t1 where a is not null and b is not null order by a, b;
+select filter(array(1, 2, 3, 4), x -> x % 2 = 0);
+select exists(array(b, 10), x -> x > 35) from t1 where b is not null order by b;
+select aggregate(array(a, b), 0, (acc, x) -> acc + x) from t1 where a is not null and b is not null order by a, b;
+select forall(array(1, 2, 3), x -> x < 10);

@@ -1,0 +1,10 @@
+-- join types incl. null keys (never match) and duplicate keys
+-- (reference inputs: join-empty-relation.sql, natural-join.sql)
+select t1.a, t1.b, t2.d from t1 join t2 on t1.a = t2.a order by t1.a, t1.b nulls first, t2.d;
+select t1.a, t1.b, t2.d from t1 left join t2 on t1.a = t2.a order by t1.a nulls first, t1.b nulls first, t2.d nulls first;
+select t1.a, t2.d from t1 right join t2 on t1.a = t2.a order by t1.a nulls first, t2.d nulls first;
+select t1.a, t1.b, t2.d from t1 full outer join t2 on t1.a = t2.a order by t1.a nulls first, t1.b nulls first, t2.d nulls first;
+select count(*) from t1 join t2 on t1.a = t2.a and t1.b < t2.d;
+select t1.a from t1 join t2 on t1.a = t2.a where t2.t = 'y' order by t1.a;
+select count(*) from t1 cross join t2;
+select t1.a, t2.a from t1 join t2 on t1.a < t2.a order by t1.a, t2.a;

@@ -1,0 +1,6 @@
+-- oracle: engine
+-- map construction / lookup (regression lock; types.MapType)
+select map('k1', a, 'k2', b) from t1 where a is not null and b is not null order by a, b;
+select element_at(map('x', 1, 'y', 2), 'y'), map('x', 1)['x'];
+select map_keys(map('a', 1, 'b', 2)), map_values(map('a', 1, 'b', 2));
+select map_contains_key(map('a', 1), 'a'), map_contains_key(map('a', 1), 'z');

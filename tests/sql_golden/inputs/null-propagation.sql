@@ -1,0 +1,10 @@
+-- three-valued logic and null arithmetic
+-- (reference inputs: null-propagation.sql, comparators.sql)
+select a + b, a - b, a * b from t1 order by a nulls first, b nulls first;
+select coalesce(a, b, 99), coalesce(c, -1.0) from t1 order by a nulls first, b nulls first;
+select nullif(b, 10) as n1, nullif(a, a) as n2 from t1 order by a nulls first, b nulls first;
+select a, b from t1 where a = 2 and b is null order by a;
+select a, b from t1 where a is null or b is null order by a nulls first, b nulls first;
+select count(*) from t1 where (a > 2) is null;
+select a from t1 where not (a < 3) order by a;
+select case when a is null then -1 else a end from t1 order by 1;

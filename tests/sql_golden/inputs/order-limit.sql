@@ -1,0 +1,8 @@
+-- ordering corners: explicit null placement, desc, limit/offset
+select a, b from t1 order by a asc nulls first, b asc nulls first;
+select a, b from t1 order by a desc nulls last, b desc nulls last;
+select a, b from t1 order by a asc nulls last, b nulls first;
+select b from t1 order by b nulls first limit 3;
+select b from t1 order by b nulls last limit 3;
+select a, b from t1 order by a nulls first, b nulls first limit 4 offset 3;
+select distinct a from t1 order by a nulls first;

@@ -1,0 +1,9 @@
+-- predicate surface: between, in, like, case nesting
+select a, b from t1 where b between 10 and 30 order by a nulls first, b;
+select a from t1 where a in (1, 3, 5) order by a;
+select a from t1 where a not in (1, 2) order by a;
+select s from t1 where s like 'a%' order by s;
+select s from t1 where s like '%an%' order by s;
+select s from t1 where s like '_pple' order by s;
+select a, case when b < 20 then 'low' when b < 45 then 'mid' else 'high' end from t1 where b is not null order by a nulls first, b;
+select a, b from t1 where (a, b) in (select a, d from t2) order by a;

@@ -1,0 +1,9 @@
+-- set-op corners: duplicates, nulls equal under set ops
+-- (reference inputs: union.sql, intersect-all.sql, except.sql)
+select a from t1 union select a from t2 order by a nulls first;
+select a from t1 union all select a from t2 order by a nulls first;
+select a from t1 intersect select a from t2 order by a nulls first;
+select a from t1 except select a from t2 order by a nulls first;
+select s from t1 union select t from t2 order by s nulls first;
+select a, b from t1 union select a, d from t2 order by a nulls first, b nulls first;
+select a from t2 except select a from t1 order by a nulls first;

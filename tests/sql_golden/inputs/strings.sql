@@ -1,0 +1,8 @@
+-- string function surface shared with the oracle
+select s, length(s), upper(s), lower(s) from t1 order by s nulls first;
+select substr(s, 1, 3) from t1 where s is not null order by s;
+select substr(s, 2) from t1 where s is not null order by s;
+select replace(s, 'a', 'o') from t1 where s is not null order by s;
+select trim('  pad  ');
+select s || '-' || t from t1 join t2 on t1.a = t2.a order by s nulls first, t;
+select s from t1 where upper(s) = 'APPLE' order by s;

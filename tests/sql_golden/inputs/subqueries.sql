@@ -1,0 +1,9 @@
+-- scalar / IN / EXISTS / correlated subqueries
+-- (reference inputs: scalar-subquery.sql, exists-subquery in subquery/)
+select a, b from t1 where b = (select max(d) from t2 where t2.a = t1.a) order by a;
+select a from t1 where exists (select 1 from t2 where t2.a = t1.a) order by a;
+select a from t1 where not exists (select 1 from t2 where t2.a = t1.a) order by a nulls first;
+select a from t1 where a in (select a from t2) order by a;
+select a from t1 where a not in (select a from t2 where a is not null) order by a;
+select (select count(*) from t2), a from t1 order by a nulls first;
+select a, (select sum(d) from t2 where t2.a = t1.a) from t1 order by a nulls first;

@@ -1,0 +1,11 @@
+-- window functions: ranking, running frames, lag/lead, partitions
+-- (reference input: window.sql)
+select a, b, row_number() over (order by a nulls first, b nulls first) from t1 order by a nulls first, b nulls first;
+select a, b, rank() over (order by b nulls first) from t1 order by a nulls first, b nulls first;
+select a, b, dense_rank() over (order by b nulls first) from t1 order by a nulls first, b nulls first;
+select a, b, sum(b) over (partition by a order by b nulls first rows between unbounded preceding and current row) from t1 order by a nulls first, b nulls first;
+select a, b, sum(b) over (partition by a) from t1 order by a nulls first, b nulls first;
+select a, b, lag(b, 1) over (order by a nulls first, b nulls first) from t1 order by a nulls first, b nulls first;
+select a, b, lead(b, 1, -1) over (order by a nulls first, b nulls first) from t1 order by a nulls first, b nulls first;
+select id, salary, sum(salary) over (order by salary nulls first rows between 1 preceding and 1 following) from emp order by salary nulls first, id;
+select a, b, min(b) over (partition by a order by b nulls first rows between current row and unbounded following) from t1 order by a nulls first, b nulls first;
