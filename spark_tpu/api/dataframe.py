@@ -549,8 +549,12 @@ class GroupedData:
         python/pyspark/sql/pandas/group_ops.py applyInPandasWithState →
         FlatMapGroupsWithStateExec). ``func(key_tuple, pandas_df,
         GroupState) -> pandas_df``; start the returned DataFrame with
-        writeStream. ``stateStructType``/``timeoutConf`` accepted for
-        surface parity (state is pickled whole; timeouts not implemented)."""
+        writeStream. ``stateStructType`` accepted for surface parity
+        (state is pickled whole). ``timeoutConf='ProcessingTimeTimeout'``
+        enables state.setTimeoutDuration(ms): groups whose deadline
+        passes with no new data are invoked with an empty frame and
+        state.hasTimedOut=True (reference:
+        FlatMapGroupsWithStateExec.scala:373 timeout processing)."""
         from spark_tpu.streaming.groups import FlatMapGroupsWithState
         from spark_tpu.types import Schema, parse_ddl_schema
 
@@ -564,8 +568,13 @@ class GroupedData:
                 raise NotImplementedError(
                     "applyInPandasWithState keys must be plain columns")
             key_names.append(inner.col_name)
+        if timeoutConf not in ("NoTimeout", "ProcessingTimeTimeout"):
+            raise NotImplementedError(
+                "timeoutConf: NoTimeout | ProcessingTimeTimeout "
+                "(event-time timeouts not implemented)")
         node = FlatMapGroupsWithState(
-            tuple(key_names), func, out_schema, self._df._plan)
+            tuple(key_names), func, out_schema, self._df._plan,
+            timeout_conf=timeoutConf)
         return DataFrame(self._df._session, node)
 
     def count(self) -> DataFrame:

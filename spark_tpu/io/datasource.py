@@ -325,16 +325,19 @@ class FileSource:
                 batch_size=rows_per_chunk):
             if rb.num_rows == 0:
                 continue
-            if pending and n + rb.num_rows > rows_per_chunk:
-                # flush BEFORE exceeding the bound: a chunk never grows
-                # past rows_per_chunk + one record batch
-                yield pa.Table.from_batches(pending)
-                pending, n = [], 0
             pending.append(rb)
             n += rb.num_rows
-            if n >= rows_per_chunk:
-                yield pa.Table.from_batches(pending)
-                pending, n = [], 0
+            while n >= rows_per_chunk:
+                # emit EXACTLY rows_per_chunk rows (remainder carries
+                # over): every chunk then pads to ONE static capacity,
+                # so the whole stream reuses a single compiled program
+                # — varying chunk sizes meant a fresh XLA compile per
+                # chunk (~minutes each on TPU at SF100)
+                tbl = pa.Table.from_batches(pending)
+                yield tbl.slice(0, rows_per_chunk)
+                rest = tbl.slice(rows_per_chunk)
+                pending = rest.to_batches() if rest.num_rows else []
+                n = rest.num_rows
         if pending:
             yield pa.Table.from_batches(pending)
 

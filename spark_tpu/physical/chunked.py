@@ -371,12 +371,16 @@ class _ChunkedAgg:
     def execute(self, conf, run_fn):
         from spark_tpu import metrics
         from spark_tpu.columnar.arrow import from_arrow
+        from spark_tpu.columnar.batch import round_capacity
 
         agg, scan = self.agg, self.big
         spec = AggSpec(agg.groupings, agg.aggregates)
         key_aliases = tuple(E.Alias(g, n) for g, n
                             in zip(spec.groupings_exec, spec.key_names))
         chunk_rows = conf.get(CHUNK_ROWS)
+        # ONE static capacity for every chunk: a varying capacity means
+        # a fresh XLA compile per chunk (~minutes each on TPU)
+        fixed_cap = round_capacity(chunk_rows)
         exact_max = conf.get(SEMI_FILTER_EXACT_MAX)
 
         # 1. materialize each sidecar ONCE; they stay device-resident
@@ -455,8 +459,10 @@ class _ChunkedAgg:
             if tbl.num_rows == 0:
                 continue
             rows_kept += tbl.num_rows
-            chunk_plan = _splice(skeleton,
-                                 {id(scan): L.Relation(from_arrow(tbl))})
+            chunk_plan = _splice(
+                skeleton,
+                {id(scan): L.Relation(from_arrow(tbl,
+                                                 capacity=fixed_cap))})
             partial = L.Aggregate(tuple(spec.groupings_exec),
                                   key_aliases + tuple(spec.partials),
                                   chunk_plan)
@@ -580,6 +586,14 @@ class _GraceHashAgg:
                 return _pa_schema_from_schema(scan.schema).empty_table()
             return pa.concat_tables(parts)
 
+        from spark_tpu.columnar.batch import round_capacity
+
+        # ONE static capacity per side across all buckets (varying
+        # capacities would compile a fresh XLA program per bucket)
+        cap_a = round_capacity(max(
+            [sum(t.num_rows for t in b or ()) for b in buckets_a] or [1]))
+        cap_b = round_capacity(max(
+            [sum(t.num_rows for t in b or ()) for b in buckets_b] or [1]))
         outer = self.join.how in ("left", "right", "full")
         for p in range(nparts):
             if not buckets_a[p] and not buckets_b[p]:
@@ -591,8 +605,10 @@ class _GraceHashAgg:
             tb = concat(buckets_b[p], self.scan_b)
             buckets_a[p] = buckets_b[p] = None  # free host RAM as we go
             chunk_plan = _splice(self.agg.child, {
-                id(self.scan_a): L.Relation(from_arrow(ta)),
-                id(self.scan_b): L.Relation(from_arrow(tb))})
+                id(self.scan_a): L.Relation(from_arrow(ta,
+                                                       capacity=cap_a)),
+                id(self.scan_b): L.Relation(from_arrow(tb,
+                                                       capacity=cap_b))})
             partial = L.Aggregate(tuple(spec.groupings_exec),
                                   key_aliases + tuple(spec.partials),
                                   chunk_plan)
@@ -643,6 +659,9 @@ class _ChunkedTopK:
                           chunk_plan))
             return L.Limit(k, L.Sort(self.sort.orders, child))
 
+        from spark_tpu.columnar.batch import round_capacity
+
+        fixed_cap = round_capacity(chunk_rows)
         state = _MergeState(merge_plan, run_fn)
         for tbl in self.big.source.iter_batches(
                 self.big.columns, self.big.filters, chunk_rows):
@@ -650,7 +669,8 @@ class _ChunkedTopK:
                 continue
             chunk_plan = _splice(
                 self.chain_root,
-                {id(self.big): L.Relation(from_arrow(tbl))})
+                {id(self.big): L.Relation(from_arrow(tbl,
+                                                     capacity=fixed_cap))})
             state.feed(chunk_plan)
         metrics.record("chunked_topk", chunks=state.chunks, k=k)
 

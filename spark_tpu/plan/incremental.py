@@ -33,8 +33,20 @@ class AggSpec:
         self.window_widths = [
             g.width if isinstance(g, E.TumblingWindow) else None
             for g in self.groupings]
+        #: gap-based session window grouping (at most one): the
+        #: streaming runner merges overlapping sessions in state
+        self.session_idx: "int | None" = None
+        self.session_gap: "int | None" = None
+        for i, g in enumerate(self.groupings):
+            if isinstance(g, E.SessionWindow):
+                if self.session_idx is not None:
+                    raise NotImplementedError(
+                        "multiple session_window groupings")
+                self.session_idx = i
+                self.session_gap = g.gap
         self.groupings_exec = [
-            g.as_arith() if isinstance(g, E.TumblingWindow) else g
+            g.as_arith() if isinstance(g, E.TumblingWindow)
+            else (g.child if isinstance(g, E.SessionWindow) else g)
             for g in self.groupings]
         self.key_names = [f"__k{i}" for i in range(len(self.groupings))]
         self.partials: List[E.Alias] = []   # over input rows

@@ -187,9 +187,16 @@ class StreamingQuery:
         batch_child = _splice(self._below, rel)
         key_aliases = tuple(E.Alias(g, n) for g, n
                             in zip(spec.groupings_exec, spec.key_names))
+        partial_outs = key_aliases + tuple(spec.partials)
+        if spec.session_idx is not None:
+            # provisional session end = max(event) + gap per provisional
+            # session key (which IS the event time, so end = key + gap)
+            ev = spec.groupings[spec.session_idx].child
+            partial_outs = partial_outs + (E.Alias(
+                E.Max(E.Arith("+", ev, E.Literal(spec.session_gap))),
+                "__send"),)
         partial = L.Aggregate(
-            tuple(spec.groupings_exec),
-            key_aliases + tuple(spec.partials), batch_child)
+            tuple(spec.groupings_exec), partial_outs, batch_child)
         partial_tbl = self._to_arrow(partial)
 
         prev = self._store.get(self._batch_id)
@@ -200,10 +207,15 @@ class StreamingQuery:
             merged_in = partial_tbl
         mrel = L.Relation(from_arrow(merged_in))
         keys = tuple(E.Col(n) for n in spec.key_names)
-        merged = L.Aggregate(
-            keys, tuple(E.Alias(E.Col(n), n) for n in spec.key_names)
-            + tuple(spec.merges), mrel)
+        merge_outs = tuple(E.Alias(E.Col(n), n)
+                           for n in spec.key_names) + tuple(spec.merges)
+        if spec.session_idx is not None:
+            merge_outs = merge_outs + (E.Alias(
+                E.Max(E.Col("__send")), "__send"),)
+        merged = L.Aggregate(keys, merge_outs, mrel)
         state_tbl = self._to_arrow(merged)
+        if spec.session_idx is not None and state_tbl.num_rows > 0:
+            state_tbl = self._merge_sessions(state_tbl)
 
         # watermark: track max event time from the new rows
         emitted: Optional[pa.Table] = None
@@ -224,6 +236,43 @@ class StreamingQuery:
         if emitted is not None and emitted.num_rows > 0:
             self._appended.append(self._finalize(emitted))
         self._register_sink()
+
+    def _merge_sessions(self, state_tbl: pa.Table) -> pa.Table:
+        """Merge overlapping/adjacent provisional sessions per key
+        (reference: MergingSessionsExec): sort by (keys, start), a
+        session chains onto the previous while start <= running max end,
+        then the chained groups re-aggregate through the SAME merge
+        accumulators with start=min(start), end=max(end)."""
+        from spark_tpu.columnar.arrow import from_arrow
+
+        spec = self._agg
+        skey = spec.key_names[spec.session_idx]
+        other = [n for i, n in enumerate(spec.key_names)
+                 if i != spec.session_idx]
+        df = state_tbl.to_pandas()
+        df = df.sort_values(other + [skey], kind="mergesort",
+                            na_position="first").reset_index(drop=True)
+        if other:
+            grp = df.groupby(other, dropna=False, sort=False)
+            prev_end = grp["__send"].cummax().shift(1)
+            new_key = grp.cumcount() == 0
+        else:
+            prev_end = df["__send"].cummax().shift(1)
+            new_key = df.index == 0
+        head = new_key | (df[skey] > prev_end)
+        df["__sid"] = head.cumsum()
+        rel = L.Relation(from_arrow(pa.Table.from_pandas(
+            df, preserve_index=False)))
+        keys2 = tuple(E.Col(n) for n in other) + (E.Col("__sid"),)
+        outs = (tuple(E.Alias(E.Col(n), n) for n in other)
+                + (E.Alias(E.Min(E.Col(skey)), skey),)
+                + tuple(spec.merges)
+                + (E.Alias(E.Max(E.Col("__send")), "__send"),))
+        merged = L.Aggregate(keys2, outs, rel)
+        out = self._to_arrow(merged)
+        # restore the state column order (concat in the next batch
+        # selects by prev.column_names)
+        return out.select(state_tbl.column_names)
 
     def _watermark(self) -> Optional[int]:
         if self._max_event_time is None:
@@ -248,6 +297,11 @@ class StreamingQuery:
             return state, None
         import pyarrow.compute as pc
 
+        if spec.session_idx is not None:
+            # a session closes when the watermark passes its END
+            closed = pc.less_equal(state.column("__send"),
+                                   pa.scalar(wm))
+            return state.filter(pc.invert(closed)), state.filter(closed)
         key = state.column(spec.key_names[idx])
         width = spec.window_widths[idx]
         if width is not None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import itertools
 import pickle
+import time as _time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
@@ -29,13 +30,32 @@ _qids = itertools.count()
 
 
 class GroupState:
-    """Per-key mutable state handle (reference: GroupState.scala)."""
+    """Per-key mutable state handle (reference: GroupState.scala).
+    ``setTimeoutDuration(ms)`` arms a PROCESSING-TIME timeout: if no new
+    rows arrive for the key before the deadline, the user function is
+    invoked once with an empty frame and ``hasTimedOut=True``
+    (reference: FlatMapGroupsWithStateExec.scala:373)."""
 
-    def __init__(self, value=None, exists: bool = False):
+    def __init__(self, value=None, exists: bool = False,
+                 deadline_ms: Optional[int] = None,
+                 has_timed_out: bool = False):
         self._value = value
         self._exists = exists
         self._removed = False
         self._updated = False
+        self._deadline_ms = deadline_ms
+        self._has_timed_out = has_timed_out
+        self._now_ms: Optional[int] = None  # set by the runner
+
+    @property
+    def hasTimedOut(self) -> bool:  # noqa: N802 (pyspark surface)
+        return self._has_timed_out
+
+    def setTimeoutDuration(self, duration_ms: int) -> None:  # noqa: N802
+        if self._now_ms is None:
+            raise ValueError(
+                "timeouts require timeoutConf='ProcessingTimeTimeout'")
+        self._deadline_ms = self._now_ms + int(duration_ms)
 
     @property
     def exists(self) -> bool:
@@ -68,6 +88,7 @@ class FlatMapGroupsWithState(L.LogicalPlan):
     func: Callable  # func(key_tuple, pandas.DataFrame, GroupState) -> pdf
     out_schema: "L.Schema"
     child: L.LogicalPlan
+    timeout_conf: str = "NoTimeout"
 
     def children(self):
         return (self.child,)
@@ -147,17 +168,45 @@ class GroupStateQuery:
         pdf = tbl.to_pandas()
 
         states = self._load_states(self._batch_id)
+        timeouts_on = self._node.timeout_conf == "ProcessingTimeTimeout"
+        now_ms = int(_time.time() * 1000)
         out_frames = []
         keys = list(self._node.keys)
+        seen: set = set()
         if len(pdf):
             for key_vals, group in pdf.groupby(keys, dropna=False):
                 kt = key_vals if isinstance(key_vals, tuple) \
                     else (key_vals,)
                 st = states.get(kt, GroupState())
+                if timeouts_on:
+                    st._now_ms = now_ms
+                    st._deadline_ms = None  # re-arm explicitly per call
+                st._has_timed_out = False
                 result = self._node.func(kt, group, st)
                 states[kt] = st
+                seen.add(kt)
                 if result is not None and len(result):
                     out_frames.append(result)
+        if timeouts_on:
+            # expired groups with no new data fire ONCE with an empty
+            # frame and hasTimedOut=True (reference:
+            # FlatMapGroupsWithStateExec.scala:373)
+            import pandas as _pd
+
+            empty_pdf = (pdf.iloc[0:0] if len(pdf.columns)
+                         else _pd.DataFrame())
+            for kt, st in list(states.items()):
+                if kt in seen or not st.exists:
+                    continue
+                if st._deadline_ms is not None \
+                        and st._deadline_ms <= now_ms:
+                    st._now_ms = now_ms
+                    st._has_timed_out = True
+                    st._deadline_ms = None
+                    result = self._node.func(kt, empty_pdf, st)
+                    st._has_timed_out = False
+                    if result is not None and len(result):
+                        out_frames.append(result)
         # drop removed states
         states = {k: s for k, s in states.items()
                   if s.exists}
@@ -179,12 +228,19 @@ class GroupStateQuery:
         key_bin = tbl.column("__key").to_pylist()
         val_bin = tbl.column("__state").to_pylist()
         for kb, vb in zip(key_bin, val_bin):
-            out[pickle.loads(kb)] = GroupState(pickle.loads(vb), True)
+            payload = pickle.loads(vb)
+            if isinstance(payload, tuple) and len(payload) == 2:
+                value, deadline = payload
+            else:  # pre-timeout checkpoint layout
+                value, deadline = payload, None
+            out[pickle.loads(kb)] = GroupState(value, True,
+                                               deadline_ms=deadline)
         return out
 
     def _commit_states(self, version: int, states: dict) -> None:
         keys = [pickle.dumps(k) for k in states]
-        vals = [pickle.dumps(s.getOption()) for s in states.values()]
+        vals = [pickle.dumps((s.getOption(), s._deadline_ms))
+                for s in states.values()]
         self._store.commit(version, pa.table({
             "__key": pa.array(keys, pa.binary()),
             "__state": pa.array(vals, pa.binary())}))

@@ -18,11 +18,11 @@ per-side watermarks (matching the reference's WatermarkTracker policy
 for multi-source queries), and rows below it leave the state — bounding
 memory exactly as the reference's state eviction does.
 
-Supported: INNER, LEFT OUTER and RIGHT OUTER equi-joins in append mode,
-with an optional extra condition (outer sides track matched bits and
-emit null-padded rows when their state evicts past the watermark —
-tests/test_stream_join.py). FULL OUTER and state timeouts are not
-implemented yet (loud error beats wrong results)."""
+Supported: INNER, LEFT OUTER, RIGHT OUTER and FULL OUTER equi-joins in
+append mode, with an optional extra condition (preserved sides track
+matched bits and emit null-padded rows when their state evicts past the
+watermark — tests/test_stream_join.py; full outer tracks BOTH sides
+symmetrically and requires watermarks on both)."""
 
 from __future__ import annotations
 
@@ -93,19 +93,25 @@ class StreamStreamJoinQuery:
                 # bare-root: restore the right-join column order
                 self._root = L.Project(
                     tuple(E.Col(n) for n in orig_names), plan)
-        if plan.how not in ("inner", "left"):
+        if plan.how not in ("inner", "left", "full"):
             raise NotImplementedError(
-                f"stream-stream {plan.how} join: inner, left and right "
-                "outer are supported (full outer needs symmetric "
-                "matched-bit state on both sides)")
-        if plan.how == "left":
+                f"stream-stream {plan.how} join: inner, left, right and "
+                "full outer are supported")
+        if plan.how in ("left", "full"):
             left_src = L.collect_nodes(plan.left, StreamingSource)[0]
             if left_src.watermark_col is None:
                 raise NotImplementedError(
-                    "left outer stream-stream join requires a watermark "
-                    "on the left side: null-padded results emit when the "
-                    "watermark proves no match can arrive (reference: "
-                    "StreamingSymmetricHashJoinExec outer-join condition)")
+                    "outer stream-stream joins require a watermark "
+                    "on the preserved side: null-padded results emit "
+                    "when the watermark proves no match can arrive "
+                    "(reference: StreamingSymmetricHashJoinExec "
+                    "outer-join condition)")
+        if plan.how == "full":
+            right_src = L.collect_nodes(plan.right, StreamingSource)[0]
+            if right_src.watermark_col is None:
+                raise NotImplementedError(
+                    "full outer stream-stream join requires watermarks "
+                    "on BOTH sides (symmetric matched-bit eviction)")
         if output_mode not in ("append", "update"):
             raise NotImplementedError(
                 "stream-stream joins support append mode only "
@@ -120,6 +126,17 @@ class StreamStreamJoinQuery:
         self._sides = (L.collect_nodes(plan.left, StreamingSource)[0],
                        L.collect_nodes(plan.right, StreamingSource)[0])
         self._subtrees = (plan.left, plan.right)
+        preserved = {0: plan.how in ("left", "full"),
+                     1: plan.how == "full"}
+        for i in (0, 1):
+            wc = self._sides[i].watermark_col
+            if preserved[i] and wc is not None \
+                    and wc not in self._subtrees[i].schema.names:
+                raise NotImplementedError(
+                    "outer stream-stream join: the preserved side's "
+                    f"watermark column {wc!r} must survive to the join "
+                    "(state eviction reads it — drop it above the join "
+                    "instead)")
         self._log = OffsetLog(checkpoint_dir)
         self._store = StateStore(checkpoint_dir)
         self._batch_id = self._log.last_committed
@@ -194,21 +211,23 @@ class StreamStreamJoinQuery:
 
         new = [self._side_rows(i, starts[i], ends[i]) for i in (0, 1)]
         state = self._load_state(self._batch_id)
-        outer = self._join.how == "left"
-        if outer:
-            # tag left rows with a deterministic-on-replay row id and a
-            # matched bit (reference: the joined-row bookkeeping in
-            # SymmetricHashJoinStateManager KeyWithIndexToValue)
-            n = new[0].num_rows
-            new0 = new[0].append_column(
-                "__lid", pa.array(
-                    [(batch_id << 32) + i for i in range(n)], pa.int64()))
-            new0 = new0.append_column(
-                "__matched", pa.array([False] * n, pa.bool_()))
-            new = [new0, new[1]]
+        # which sides are PRESERVED (emit null-padded when unmatched):
+        # left outer tracks side 0; full outer tracks both (reference:
+        # SymmetricHashJoinStateManager KeyWithIndexToValue bookkeeping)
+        track = {0: self._join.how in ("left", "full"),
+                 1: self._join.how == "full"}
+        tag = {0: "__lid", 1: "__rid"}
+        flag = {0: "__matched", 1: "__matched_r"}
+        for i in (0, 1):
+            if track[i]:
+                n = new[i].num_rows
+                tagged = new[i].append_column(tag[i], pa.array(
+                    [(batch_id << 32) + j for j in range(n)], pa.int64()))
+                new[i] = tagged.append_column(
+                    flag[i], pa.array([False] * n, pa.bool_()))
 
         out_parts = []
-        matched_lids: set = set()
+        matched: dict = {0: set(), 1: set()}
         right_all = pa.concat_tables([state[1], new[1]]) \
             if state[1].num_rows else new[1]
         joinables = []
@@ -217,12 +236,16 @@ class StreamStreamJoinQuery:
         if state[0].num_rows and new[1].num_rows:
             joinables.append((state[0], new[1]))
         for lt, rt in joinables:
-            joined = self._join_tables(
-                lt.drop_columns(["__matched"]) if outer else lt, rt)
-            if outer:
-                matched_lids |= set(
-                    joined.column("__lid").to_pylist())
-                joined = joined.drop_columns(["__lid"])
+            if track[0]:
+                lt = lt.drop_columns([flag[0]])
+            if track[1]:
+                rt = rt.drop_columns([flag[1]])
+            joined = self._join_tables(lt, rt)
+            for i in (0, 1):
+                if track[i]:
+                    matched[i] |= set(
+                        joined.column(tag[i]).to_pylist())
+                    joined = joined.drop_columns([tag[i]])
             out_parts.append(joined)
         out_parts = [self._apply_above(t) for t in out_parts]
 
@@ -232,18 +255,19 @@ class StreamStreamJoinQuery:
             if state[i].num_rows else new[i]
             for i in (0, 1)
         ]
-        if outer and matched_lids and new_state[0].num_rows:
-            lids = new_state[0].column("__lid").to_pylist()
-            flags = new_state[0].column("__matched").to_pylist()
-            flags = [f or (lid in matched_lids)
-                     for f, lid in zip(flags, lids)]
-            idx = new_state[0].schema.get_field_index("__matched")
-            new_state[0] = new_state[0].set_column(
-                idx, "__matched", pa.array(flags, pa.bool_()))
+        for i in (0, 1):
+            if track[i] and matched[i] and new_state[i].num_rows:
+                ids = new_state[i].column(tag[i]).to_pylist()
+                flags = new_state[i].column(flag[i]).to_pylist()
+                flags = [f or (x in matched[i])
+                         for f, x in zip(flags, ids)]
+                idx = new_state[i].schema.get_field_index(flag[i])
+                new_state[i] = new_state[i].set_column(
+                    idx, flag[i], pa.array(flags, pa.bool_()))
 
-        # watermark-trim state; evicted unmatched left rows emit
-        # null-padded (this is WHEN outer results appear — the watermark
-        # proves no future right row can match them)
+        # watermark-trim state; evicted unmatched preserved-side rows
+        # emit null-padded (this is WHEN outer results appear — the
+        # watermark proves no future row can match them)
         wm = self._watermark()
         if wm is not None:
             for i in (0, 1):
@@ -252,13 +276,13 @@ class StreamStreamJoinQuery:
                         and wm_col in new_state[i].column_names:
                     keep = pc.greater_equal(
                         new_state[i].column(wm_col), pa.scalar(wm))
-                    if outer and i == 0:
+                    if track[i]:
                         evicted = new_state[i].filter(pc.invert(keep))
                         unmatched = evicted.filter(
-                            pc.invert(evicted.column("__matched")))
+                            pc.invert(evicted.column(flag[i])))
                         if unmatched.num_rows:
                             out_parts.append(self._apply_above(
-                                self._null_padded(unmatched)))
+                                self._null_padded(unmatched, side=i)))
                     new_state[i] = new_state[i].filter(keep)
 
         self._commit_state(batch_id, new_state)
@@ -269,18 +293,28 @@ class StreamStreamJoinQuery:
                 self._appended.append(t)
         self._register_sink()
 
-    def _null_padded(self, left_rows: pa.Table) -> pa.Table:
-        """Unmatched left rows shaped like the join output: left columns
-        + all-null right columns."""
+    def _null_padded(self, rows: pa.Table, side: int = 0) -> pa.Table:
+        """Unmatched preserved-side rows shaped like the join output:
+        that side's columns + all-null columns for the other side."""
         from spark_tpu.io.datasource import _pa_schema_from_schema
 
-        left_clean = left_rows.drop_columns(["__lid", "__matched"])
-        n = left_clean.num_rows
+        clean = rows.drop_columns(
+            [c for c in ("__lid", "__matched", "__rid", "__matched_r")
+             if c in rows.column_names])
+        n = clean.num_rows
         out_schema = _pa_schema_from_schema(self._join.schema)
+        # join output = left fields then right fields (dedup-renamed);
+        # map this side's columns positionally into its region
+        ln = len(self._subtrees[0].schema.names)
         arrays = []
-        for f in out_schema:
-            if f.name in left_clean.column_names:
-                arrays.append(left_clean.column(f.name).cast(f.type))
+        for pos, f in enumerate(out_schema):
+            src = None
+            if side == 0 and pos < ln:
+                src = self._subtrees[0].schema.names[pos]
+            elif side == 1 and pos >= ln:
+                src = self._subtrees[1].schema.names[pos - ln]
+            if src is not None and src in clean.column_names:
+                arrays.append(clean.column(src).cast(f.type))
             else:
                 arrays.append(pa.nulls(n, f.type))
         return pa.Table.from_arrays(arrays, schema=out_schema)

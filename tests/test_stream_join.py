@@ -100,7 +100,8 @@ def test_checkpoint_restart(spark, tmp_path):
 
 def test_unsupported_outer_shapes_rejected_loudly(spark):
     left, right, ldf, rdf = _sources(spark)
-    with pytest.raises(NotImplementedError, match="matched-bit"):
+    # full outer without watermarks on both sides cannot evict
+    with pytest.raises(NotImplementedError, match="watermark"):
         ldf.join(rdf, on="k", how="full").writeStream \
             .outputMode("append").start()
     # left outer without a left-side watermark cannot ever emit nulls
@@ -178,3 +179,38 @@ def test_right_outer_join_via_swap(spark):
             for r in spark.sql("select k, lv, rv from ssro").collect()}
     assert (2, None, 200) in rows
     assert (9, 90, 900) in rows
+
+
+def test_full_outer_join_symmetric_eviction(spark):
+    """FULL OUTER stream-stream join: unmatched rows from BOTH sides
+    emit null-padded when their watermark evicts them (reference:
+    StreamingSymmetricHashJoinExec with symmetric matched bits)."""
+    left = MemoryStream(pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                                   ("lv", pa.int64())]))
+    right = MemoryStream(pa.schema([("t2", pa.int64()), ("k", pa.int64()),
+                                    ("rv", pa.int64())]))
+    ldf = spark.readStream.load(left).withWatermark("t", 10)
+    rdf = spark.readStream.load(right).withWatermark("t2", 10)
+    q = ldf.join(rdf, on="k", how="full").writeStream \
+        .outputMode("append").queryName("ssfo").start()
+
+    left.add_data([{"t": 0, "k": 1, "lv": 10},
+                   {"t": 0, "k": 2, "lv": 20}])
+    right.add_data([{"t2": 0, "k": 1, "rv": 100},
+                    {"t2": 0, "k": 3, "rv": 300}])
+    q.processAllAvailable()
+    rows = {(r["k"], r["lv"], r["rv"])
+            for r in spark.sql("select k, lv, rv from ssfo").collect()}
+    assert rows == {(1, 10, 100)}  # k=2 / k=3 pending
+
+    # advance both watermarks: k=2 (left) and k=3 (right) evict
+    left.add_data([{"t": 100, "k": 9, "lv": 90}])
+    right.add_data([{"t2": 100, "k": 9, "rv": 900}])
+    q.processAllAvailable()
+    rows = {(r["k"], r["lv"], r["rv"])
+            for r in spark.sql("select k, lv, rv from ssfo").collect()}
+    assert (2, 20, None) in rows       # unmatched LEFT
+    assert (3, None, 300) in rows      # unmatched RIGHT
+    assert (9, 90, 900) in rows
+    assert (1, 10, None) not in rows
+    assert (1, None, 100) not in rows

@@ -230,3 +230,93 @@ def test_append_agg_without_time_key_rejected(spark):
         .agg(F.count("k").alias("n"))
     with pytest.raises(NotImplementedError):
         agg.writeStream.outputMode("append").queryName("x2").start()
+
+
+def test_session_window_merging(spark):
+    """Gap-based sessions merge across micro-batches (reference:
+    MergingSessionsExec): events within gap=5 of each other chain into
+    one session; append mode emits a session when the watermark passes
+    its end."""
+    src = MemoryStream(pa.schema([("ts", pa.int64()), ("k", pa.string()),
+                                  ("v", pa.int64())]))
+    df = spark.readStream.load(src).withWatermark("ts", 0)
+    sess = F.session_window(F.col("ts"), 5).alias("sstart")
+    agg = df.groupBy(sess, F.col("k")).agg(F.count("v").alias("n"),
+                                           F.sum("v").alias("s"))
+    q = agg.writeStream.outputMode("append").queryName("sw1").start()
+
+    # a: 1,3,6 chain (gaps < 5); b: 2 alone
+    src.add_data([{"ts": 1, "k": "a", "v": 10},
+                  {"ts": 3, "k": "a", "v": 20},
+                  {"ts": 2, "k": "b", "v": 5}])
+    q.process_all_available()
+    src.add_data([{"ts": 6, "k": "a", "v": 30}])
+    q.process_all_available()
+    # watermark = 6: b's session [2,7) not yet closed; nothing emitted
+    # for a (session end now 11)
+    src.add_data([{"ts": 30, "k": "c", "v": 1}])
+    q.process_all_available()
+    # watermark = 30: a's [1,11) and b's [2,7) close
+    got = {(r.sstart, r.k): (r.n, r.s) for r in
+           spark.sql("select * from sw1").collect()}
+    assert got[(1, "a")] == (3, 60)   # merged across two batches
+    assert got[(2, "b")] == (1, 5)
+
+
+def test_session_window_gap_split(spark):
+    """Events farther apart than the gap form separate sessions."""
+    src = MemoryStream(pa.schema([("ts", pa.int64()), ("v", pa.int64())]))
+    df = spark.readStream.load(src).withWatermark("ts", 0)
+    agg = df.groupBy(F.session_window(F.col("ts"), 3).alias("st")) \
+        .agg(F.count("v").alias("n"))
+    q = agg.writeStream.outputMode("append").queryName("sw2").start()
+    src.add_data([{"ts": 1, "v": 1}, {"ts": 2, "v": 1},
+                  {"ts": 10, "v": 1}, {"ts": 12, "v": 1}])
+    q.process_all_available()
+    src.add_data([{"ts": 50, "v": 1}])
+    q.process_all_available()
+    got = {(r.st, r.n) for r in spark.sql("select * from sw2").collect()}
+    assert got == {(1, 2), (10, 2)}
+
+
+def test_flat_map_groups_processing_time_timeout(spark):
+    """flatMapGroupsWithState with ProcessingTimeTimeout: a group whose
+    deadline expires with no new data fires once with hasTimedOut=True
+    (reference: FlatMapGroupsWithStateExec.scala:373)."""
+    import pandas as pd
+
+    src = MemoryStream(pa.schema([("k", pa.string()), ("v", pa.int64())]))
+    df = spark.readStream.load(src)
+
+    def fn(key, pdf, state):
+        if state.hasTimedOut:
+            total = state.get()
+            state.remove()
+            return pd.DataFrame({"k": [key[0]], "total": [total],
+                                 "reason": ["timeout"]})
+        cur = state.getOption() or 0
+        state.update(cur + int(pdf["v"].sum()))
+        state.setTimeoutDuration(0)  # expire immediately on next batch
+        return None
+
+    q = (df.groupBy("k")
+         .applyInPandasWithState(fn, "k string, total bigint, reason string",
+                                 timeoutConf="ProcessingTimeTimeout")
+         .writeStream.outputMode("append").queryName("fmt1").start())
+    src.add_data([{"k": "a", "v": 1}, {"k": "a", "v": 2}])
+    q.process_all_available()
+    assert spark.sql("select * from fmt1").collect() == []
+    # next batch (new key only): a's deadline has passed -> timeout fires
+    src.add_data([{"k": "b", "v": 9}])
+    q.process_all_available()
+    rows = {(r.k, r.total, r.reason) for r in
+            spark.sql("select * from fmt1").collect()}
+    assert rows == {("a", 3, "timeout")}
+    # a's state removed: fresh data starts a new accumulation
+    src.add_data([{"k": "a", "v": 7}])
+    q.process_all_available()
+    src.add_data([{"k": "c", "v": 1}])
+    q.process_all_available()
+    rows = {(r.k, r.total, r.reason) for r in
+            spark.sql("select * from fmt1").collect()}
+    assert ("a", 7, "timeout") in rows
