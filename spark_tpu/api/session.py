@@ -299,8 +299,19 @@ class SparkSession:
             from spark_tpu.parallel.mesh import make_mesh
 
             self._mesh = make_mesh(None if n == -1 else int(n))
+        # live status UI/REST server (reference: SparkUI.scala:40),
+        # gated on spark.ui.enabled
+        from spark_tpu import ui as _ui
+
+        self._ui = _ui.maybe_start(self)
         # last: plugins may exercise any session API from init(session)
         self.extensions.load_plugins(self)
+
+    @property
+    def ui_web_url(self) -> Optional[str]:
+        """URL of the live status UI when enabled (reference:
+        SparkContext.uiWebUrl)."""
+        return self._ui.url if self._ui is not None else None
 
     @property
     def mesh_executor(self):
@@ -415,6 +426,9 @@ class SparkSession:
 
     def stop(self) -> None:
         self._stopped = True
+        if self._ui is not None:
+            self._ui.stop()
+            self._ui = None
         self.extensions.shutdown_plugins()
         SparkSession._reset()
 

@@ -48,6 +48,12 @@ def _iter_events(path: str):
 def summarize(path: str) -> List[Dict[str, Any]]:
     """Fold the event stream into queries: each ``query_start`` mark
     opens a bucket; stage events accumulate wall time per operator."""
+    return summarize_events(_iter_events(path))
+
+
+def summarize_events(events) -> List[Dict[str, Any]]:
+    """Fold an event ITERABLE (JSONL file or the live in-memory ring —
+    the live UI server in spark_tpu.ui reads the ring through this)."""
     queries: List[Dict[str, Any]] = []
     current: Optional[Dict[str, Any]] = None
 
@@ -57,7 +63,7 @@ def summarize(path: str) -> List[Dict[str, Any]]:
             queries.append(current)
             current = None
 
-    for ev in _iter_events(path):
+    for ev in events:
         kind = ev.get("kind", "")
         if kind == "query_start":
             close()

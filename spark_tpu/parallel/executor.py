@@ -337,7 +337,13 @@ class MeshExecutor:
         ex = D.HashPartitionExchangeExec(tuple(groupings), child)
         return D.DistSortAggExec(groupings, aggregates, ex)
 
-    def _shard_relation(self, batch: Batch) -> ShardedBatch:
+    def _shard_relation(self, batch) -> ShardedBatch:
+        if isinstance(batch, ShardedBatch):
+            # already globally placed (multi-host addressable-shard
+            # feeding, multihost.sharded_batch_from_local): every
+            # process contributed its OWN rows — no host gathering, no
+            # single-process placement assumptions
+            return batch
         sb = self._relation_cache.get(batch)
         if sb is None:
             sb = ShardedBatch.from_batch(batch, self.mesh)

@@ -1,0 +1,121 @@
+"""Live status server: the reference serves a web UI + REST API while
+queries run (reference: core/.../ui/SparkUI.scala:40, the
+api/v1 endpoints under status/api/v1/ApiRootResource.scala). Here a
+stdlib ThreadingHTTPServer reads the live in-memory metrics ring —
+no web framework, no state of its own, always consistent with what the
+engine just did.
+
+Endpoints:
+  /                     HTML (history.render_html over the live ring)
+  /api/v1/queries       per-query rollups (JSON)
+  /api/v1/events?n=200  recent raw events (JSON)
+  /api/v1/status        app name, event count, active query
+
+Enable per session with ``spark.ui.enabled=true`` (port:
+``spark.ui.port``, 0 = ephemeral) or programmatically::
+
+    from spark_tpu.ui import StatusServer
+    srv = StatusServer(spark)        # srv.port, srv.url
+    ...
+    srv.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from spark_tpu import conf as CF
+from spark_tpu import history, metrics
+
+UI_ENABLED = CF.register(
+    "spark.ui.enabled", False,
+    "Serve the live status UI/REST API for this session (reference: "
+    "spark.ui.enabled).", bool)
+
+UI_PORT = CF.register(
+    "spark.ui.port", 4040,
+    "Port for the live status UI; 0 binds an ephemeral port "
+    "(reference: spark.ui.port).", int)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "spark-tpu-ui/1"
+
+    def log_message(self, *args) -> None:  # quiet by default
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj) -> None:
+        self._send(200, json.dumps(obj, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        events = metrics.recent(int(q.get("n", ["5000"])[0]))
+        if url.path in ("/", "/index.html"):
+            queries = history.summarize_events(events)
+            self._send(200, history.render_html(queries).encode(),
+                       "text/html; charset=utf-8")
+        elif url.path == "/api/v1/queries":
+            self._json(history.summarize_events(events))
+        elif url.path == "/api/v1/events":
+            self._json(events)
+        elif url.path == "/api/v1/status":
+            session = getattr(self.server, "spark_session", None)
+            active = None
+            for ev in reversed(events):
+                if ev.get("kind") == "query_start":
+                    active = ev.get("description")
+                    break
+            self._json({
+                "app": getattr(session, "app_name", "spark-tpu"),
+                "events": len(events),
+                "active_query": active,
+            })
+        else:
+            self._send(404, b"not found", "text/plain")
+
+
+class StatusServer:
+    """One live UI per session; serves until stop() (daemon thread)."""
+
+    def __init__(self, session=None, port: Optional[int] = None):
+        if port is None:
+            try:
+                port = session.conf.get(UI_PORT) if session else 0
+            except Exception:
+                port = 0
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.spark_session = session  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="spark-tpu-ui",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def maybe_start(session) -> Optional[StatusServer]:
+    """Start the UI when spark.ui.enabled is set (SparkSession calls
+    this at construction)."""
+    try:
+        if session.conf.get(UI_ENABLED):
+            return StatusServer(session)
+    except Exception:
+        pass
+    return None

@@ -1,0 +1,78 @@
+"""Live status UI/REST server (reference: ui/SparkUI.scala:40,
+status/api/v1): serves the in-memory event ring WHILE queries run."""
+
+import json
+import urllib.request
+
+from spark_tpu.ui import StatusServer
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_live_ui_serves_active_session(spark):
+    from spark_tpu import metrics
+
+    srv = StatusServer(spark, port=0)
+    try:
+        metrics.reset()
+        df = spark.createDataFrame([{"k": i % 3, "v": i}
+                                    for i in range(100)])
+        df.createOrReplaceTempView("uit")
+        rows = spark.sql(
+            "select k, sum(v) as s from uit group by k order by k"
+        ).collect()
+        assert len(rows) == 3
+
+        code, body = _get(srv.url + "/")
+        assert code == 200 and b"<html" in body.lower()
+
+        code, body = _get(srv.url + "/api/v1/queries")
+        queries = json.loads(body)
+        assert code == 200 and queries
+        assert any("uit" in q["label"] or "select" in q["label"].lower()
+                   or q["stages"] for q in queries)
+
+        code, body = _get(srv.url + "/api/v1/status")
+        st = json.loads(body)
+        assert st["app"] == spark.app_name
+        assert st["events"] > 0
+        assert st["active_query"] is not None
+
+        code, body = _get(srv.url + "/api/v1/events?n=50")
+        evs = json.loads(body)
+        assert code == 200 and 0 < len(evs) <= 50
+
+        import urllib.error
+
+        try:
+            _get(srv.url + "/nosuch")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_ui_conf_gated(spark):
+    import urllib.error
+
+    from spark_tpu import conf as _conf
+    from spark_tpu import ui
+
+    c = _conf.RuntimeConf()
+    assert c.get(ui.UI_ENABLED) is False  # off by default
+
+    srv = StatusServer(None, port=0)
+    try:
+        code, body = _get(srv.url + "/api/v1/status")
+        assert code == 200
+    finally:
+        srv.stop()
+    try:
+        _get(srv.url + "/api/v1/status")
+        assert False, "server should be down"
+    except (urllib.error.URLError, ConnectionError, OSError):
+        pass
