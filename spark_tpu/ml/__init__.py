@@ -20,3 +20,11 @@ from spark_tpu.ml.clustering import KMeans
 __all__ = ["Estimator", "Transformer", "Model", "Pipeline",
            "StandardScaler", "StringIndexer", "LinearRegression",
            "LogisticRegression", "KMeans"]
+from spark_tpu.ml.tree import (DecisionTreeClassifier,  # noqa: F401,E402
+                               DecisionTreeRegressor,
+                               RandomForestClassifier,
+                               RandomForestRegressor)
+from spark_tpu.ml.tuning import (CrossValidator,  # noqa: F401,E402
+                                 ParamGridBuilder)
+from spark_tpu.ml.evaluation import (  # noqa: F401,E402
+    MulticlassClassificationEvaluator, RegressionEvaluator)

@@ -1,0 +1,273 @@
+"""Decision trees + random forests (reference: ml/tree/ —
+DecisionTreeClassifier.scala, RandomForest.scala level-wise training
+over binned features, impurity/Variance.scala + Gini).
+
+TPU-first formulation: the classic RandomForest.scala loop builds
+per-(node, feature, bin) histograms by iterating rows on executors;
+here the SAME level-wise histogram algorithm is a handful of MXU
+matmuls — a one-hot (cells x rows) matrix times the (rows, 3) stats
+matrix [1, y, y^2] yields every node's histogram in one shot, prefix
+sums over bins give all candidate splits, and the argmax picks each
+node's (feature, threshold) simultaneously. Rows never leave the
+device during growth.
+
+Prediction compiles the fitted tree into nested CASE expressions, so
+scoring fuses into whatever query pipeline follows (the reference
+walks Node objects per row on the JVM)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_tpu.expr import expressions as E
+from spark_tpu.ml.pipeline import Estimator, Model, features_matrix
+
+_BINS = 32
+
+
+def _bin_features(x: jnp.ndarray):
+    """Quantile-bin each feature column to int32 codes + edge values
+    (reference: RandomForest.scala findSplits quantile sketching)."""
+    qs = jnp.linspace(0.0, 1.0, _BINS + 1)[1:-1]
+    edges = jnp.quantile(x, qs, axis=0)  # (B-1, d)
+    binned = jnp.sum(x[None, :, :] > edges[:, None, :], axis=0)
+    return binned.astype(jnp.int32), edges
+
+
+def _grow_tree(xb: jnp.ndarray, edges: jnp.ndarray, y: jnp.ndarray,
+               max_depth: int, min_rows: int, rng: np.random.Generator,
+               sample_weight: Optional[np.ndarray] = None,
+               feature_frac: float = 1.0):
+    """Level-wise growth; returns a dict-shaped tree:
+    {node_id: (feature, threshold_value)} for internal nodes and
+    {node_id: leaf_value} for leaves (node ids heap-ordered: children
+    of i are 2i+1 / 2i+2). Split criterion: variance reduction (squared
+    loss — Gini for 0/1 labels is an affine transform of it, so one
+    criterion serves both learners, impurity/Variance.scala)."""
+    n, d = xb.shape
+    feat_mask = np.ones((d,), bool)
+    if feature_frac < 1.0:
+        k = max(1, int(round(feature_frac * d)))
+        feat_mask[:] = False
+        feat_mask[rng.choice(d, size=k, replace=False)] = True
+    w = (jnp.asarray(sample_weight.astype(np.float32))
+         if sample_weight is not None else jnp.ones((n,), jnp.float32))
+
+    node = jnp.zeros((n,), jnp.int32)  # heap position per row
+    splits = {}  # node_id -> (feature, threshold_value, bin)
+    leaves = {}  # node_id -> value
+    level_nodes = [0]
+    for depth in range(max_depth + 1):
+        if not level_nodes:
+            break
+        n_level = len(level_nodes)
+        # heap ids at a level are sparse (leaf siblings drop out):
+        # map to dense local ids with a small where-chain
+        local = jnp.full((n,), n_level, jnp.int32)
+        for i, nid in enumerate(level_nodes):
+            local = jnp.where(node == nid, i, local)
+        in_level = local < n_level
+        cells = n_level * _BINS
+        stats = jnp.stack([w, w * y, w * y * y], axis=1)  # (n, 3)
+        hists = []
+        for f in range(d):
+            if not feat_mask[f]:
+                hists.append(None)
+                continue
+            key = jnp.where(in_level, local * _BINS + xb[:, f], cells)
+            onehot = (key[:, None]
+                      == jnp.arange(cells)[None, :]).astype(jnp.float32)
+            hists.append((onehot.T @ stats).reshape(n_level, _BINS, 3))
+
+        # per node: total stats (same for every feature)
+        any_f = next(h for h in hists if h is not None)
+        tot = any_f.sum(axis=1)  # (n_level, 3)
+        best_gain = np.full((n_level,), 1e-12)
+        best_feat = np.full((n_level,), -1, np.int64)
+        best_bin = np.zeros((n_level,), np.int64)
+        for f in range(d):
+            if hists[f] is None:
+                continue
+            h = hists[f]
+            lc = jnp.cumsum(h, axis=1)[:, :-1, :]  # left of bin b+1
+            rc = tot[:, None, :] - lc
+            ln, ls = lc[..., 0], lc[..., 1]
+            rn, rs = rc[..., 0], rc[..., 1]
+            ok = (ln >= min_rows) & (rn >= min_rows)
+            # variance reduction == sum of per-side (sum^2/count) up to
+            # a constant; maximize that
+            gain = jnp.where(
+                ok,
+                ls * ls / jnp.maximum(ln, 1e-9)
+                + rs * rs / jnp.maximum(rn, 1e-9),
+                -jnp.inf)
+            base_score = (tot[:, 1] ** 2
+                          / jnp.maximum(tot[:, 0], 1e-9))
+            g = np.asarray(jnp.max(gain, axis=1) - base_score)
+            b = np.asarray(jnp.argmax(gain, axis=1))
+            upd = g > best_gain
+            best_gain = np.where(upd, g, best_gain)
+            best_feat = np.where(upd, f, best_feat)
+            best_bin = np.where(upd, b, best_bin)
+
+        tot_np = np.asarray(tot)
+        next_level = []
+        for i, nid in enumerate(level_nodes):
+            mean = (tot_np[i, 1] / tot_np[i, 0]
+                    if tot_np[i, 0] > 0 else 0.0)
+            if depth == max_depth or best_feat[i] < 0 \
+                    or tot_np[i, 0] < 2 * min_rows:
+                leaves[nid] = float(mean)
+                continue
+            f, b = int(best_feat[i]), int(best_bin[i])
+            thr = float(np.asarray(edges)[b, f])
+            splits[nid] = (f, thr, b)
+            next_level.extend([2 * nid + 1, 2 * nid + 2])
+        # reassign rows of split nodes
+        new_node = node
+        for i, nid in enumerate(level_nodes):
+            if nid not in splits:
+                continue
+            f, _, b = splits[nid]
+            here = node == nid
+            left = xb[:, f] <= b
+            new_node = jnp.where(here & left, 2 * nid + 1,
+                                 jnp.where(here, 2 * nid + 2, new_node))
+        node = new_node
+        level_nodes = sorted(next_level)
+    return splits, leaves
+
+
+class _TreeFit:
+    """One fitted tree as parallel dicts keyed by node id."""
+
+    def __init__(self, splits, leaves, features: List[str]):
+        self.splits = splits
+        self.leaves = leaves
+        self.features = features
+
+    def to_expr(self, nid: int = 0) -> E.Expression:
+        """Nested CASE over feature columns (fuses into the plan)."""
+        if nid in self.leaves:
+            return E.Literal(float(self.leaves[nid]))
+        f, thr, _ = self.splits[nid]
+        cond = E.Cmp("<=", E.Col(self.features[f]), E.Literal(thr))
+        return E.Case(((cond, self.to_expr(2 * nid + 1)),),
+                      self.to_expr(2 * nid + 2))
+
+
+class DecisionTreeRegressor(Estimator):
+    """CART regression tree (reference: ml/regression/
+    DecisionTreeRegressor.scala)."""
+
+    def __init__(self, featuresCols: Sequence[str], labelCol: str,
+                 predictionCol: str = "prediction", maxDepth: int = 5,
+                 minInstancesPerNode: int = 1, seed: int = 42):
+        self.features_cols = list(featuresCols)
+        self.label_col = labelCol
+        self.prediction_col = predictionCol
+        self.max_depth = maxDepth
+        self.min_rows = minInstancesPerNode
+        self.seed = seed
+        self._classifier = False
+
+    def _fit_trees(self, df, n_trees: int, feature_frac: float,
+                   bootstrap: bool):
+        xy = features_matrix(df, self.features_cols + [self.label_col])
+        x, y = xy[:, :-1], xy[:, -1]
+        xb, edges = _bin_features(x)
+        rng = np.random.default_rng(self.seed)
+        n = int(x.shape[0])
+        fits = []
+        for _ in range(n_trees):
+            w = None
+            if bootstrap:
+                w = np.bincount(rng.integers(0, n, n),
+                                minlength=n).astype(np.float32)
+            s, lv = _grow_tree(xb, edges, y, self.max_depth,
+                               self.min_rows, rng, sample_weight=w,
+                               feature_frac=feature_frac)
+            fits.append(_TreeFit(s, lv, self.features_cols))
+        return fits
+
+    def fit(self, df) -> "TreeEnsembleModel":
+        fits = self._fit_trees(df, 1, 1.0, bootstrap=False)
+        return TreeEnsembleModel(self, fits)
+
+
+class DecisionTreeClassifier(DecisionTreeRegressor):
+    """Binary classification tree: 0/1 labels make Gini an affine
+    transform of variance, so the regression grower serves directly
+    (reference: ml/classification/DecisionTreeClassifier.scala +
+    impurity/Gini)."""
+
+    def __init__(self, featuresCols: Sequence[str], labelCol: str,
+                 predictionCol: str = "prediction",
+                 probabilityCol: str = "probability",
+                 maxDepth: int = 5, minInstancesPerNode: int = 1,
+                 seed: int = 42):
+        super().__init__(featuresCols, labelCol, predictionCol,
+                         maxDepth, minInstancesPerNode, seed)
+        self.probability_col = probabilityCol
+        self._classifier = True
+
+
+class RandomForestRegressor(DecisionTreeRegressor):
+    """Bagged ensemble: bootstrap rows + feature subsampling per tree
+    (reference: ml/regression/RandomForestRegressor.scala,
+    RandomForest.scala)."""
+
+    def __init__(self, featuresCols: Sequence[str], labelCol: str,
+                 predictionCol: str = "prediction", numTrees: int = 20,
+                 maxDepth: int = 5, minInstancesPerNode: int = 1,
+                 featureSubsetStrategy: float = 0.7, seed: int = 42):
+        super().__init__(featuresCols, labelCol, predictionCol,
+                         maxDepth, minInstancesPerNode, seed)
+        self.num_trees = numTrees
+        self.feature_frac = float(featureSubsetStrategy)
+
+    def fit(self, df) -> "TreeEnsembleModel":
+        fits = self._fit_trees(df, self.num_trees, self.feature_frac,
+                               bootstrap=True)
+        return TreeEnsembleModel(self, fits)
+
+
+class RandomForestClassifier(RandomForestRegressor):
+    def __init__(self, featuresCols: Sequence[str], labelCol: str,
+                 predictionCol: str = "prediction",
+                 probabilityCol: str = "probability",
+                 numTrees: int = 20, maxDepth: int = 5,
+                 minInstancesPerNode: int = 1,
+                 featureSubsetStrategy: float = 0.7, seed: int = 42):
+        super().__init__(featuresCols, labelCol, predictionCol,
+                         numTrees, maxDepth, minInstancesPerNode,
+                         featureSubsetStrategy, seed)
+        self.probability_col = probabilityCol
+        self._classifier = True
+
+
+class TreeEnsembleModel(Model):
+    """Average of per-tree CASE expressions; classification thresholds
+    the mean leaf probability at 0.5."""
+
+    def __init__(self, est, fits: List[_TreeFit]):
+        self.est = est
+        self.fits = fits
+
+    def transform(self, df):
+        score: E.Expression = self.fits[0].to_expr()
+        for f in self.fits[1:]:
+            score = E.Arith("+", score, f.to_expr())
+        if len(self.fits) > 1:
+            score = E.Arith("/", score, E.Literal(float(len(self.fits))))
+        if getattr(self.est, "_classifier", False):
+            df = df.withColumn(self.est.probability_col, score)
+            pred = E.Case(
+                ((E.Cmp(">", E.Col(self.est.probability_col),
+                        E.Literal(0.5)), E.Literal(1.0)),),
+                E.Literal(0.0))
+            return df.withColumn(self.est.prediction_col, pred)
+        return df.withColumn(self.est.prediction_col, score)

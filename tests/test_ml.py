@@ -104,3 +104,73 @@ def test_string_indexer_frequency_order(spark):
     assert model.labels == ["b", "a", "c"]  # by desc frequency
     out = {(r.c, r.c_idx) for r in model.transform(df).collect()}
     assert ("b", 0.0) in out and ("a", 1.0) in out and ("c", 2.0) in out
+
+
+def _xor_df(spark, n=4000, seed=3):
+    """Nonlinear (XOR-ish) data: linear models cap near 50%, trees
+    should exceed 90%."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = ((x1 > 0) ^ (x2 > 0)).astype(np.float64)
+    return spark.createDataFrame(
+        [{"x1": float(a), "x2": float(b), "label": float(c)}
+         for a, b, c in zip(x1, x2, y)])
+
+
+def test_decision_tree_beats_logistic_on_xor(spark):
+    from spark_tpu.ml import (DecisionTreeClassifier, LogisticRegression,
+                              MulticlassClassificationEvaluator)
+
+    df = _xor_df(spark)
+    ev = MulticlassClassificationEvaluator(labelCol="label")
+    tree = DecisionTreeClassifier(["x1", "x2"], "label", maxDepth=4)
+    tree_acc = ev.evaluate(tree.fit(df).transform(df))
+    lr = LogisticRegression(["x1", "x2"], "label", maxIter=100)
+    lr_acc = ev.evaluate(lr.fit(df).transform(df))
+    assert tree_acc > 0.9, tree_acc
+    assert tree_acc > lr_acc + 0.2, (tree_acc, lr_acc)
+
+
+def test_random_forest_regression(spark):
+    import numpy as np
+
+    from spark_tpu.ml import RandomForestRegressor, RegressionEvaluator
+
+    rng = np.random.default_rng(5)
+    n = 3000
+    x = rng.uniform(-2, 2, size=(n, 2))
+    y = np.sin(x[:, 0]) * 2 + np.where(x[:, 1] > 0, 3.0, -3.0)
+    df = spark.createDataFrame(
+        [{"a": float(r[0]), "b": float(r[1]), "label": float(t)}
+         for r, t in zip(x, y)])
+    rf = RandomForestRegressor(["a", "b"], "label", numTrees=10,
+                               maxDepth=5, featureSubsetStrategy=1.0)
+    pred = rf.fit(df).transform(df)
+    rmse = RegressionEvaluator(labelCol="label").evaluate(pred)
+    assert rmse < 1.0, rmse  # label std is ~3.3: the forest must learn
+
+
+def test_cross_validator_picks_deeper_tree(spark):
+    from spark_tpu.ml import (CrossValidator, DecisionTreeClassifier,
+                              MulticlassClassificationEvaluator,
+                              ParamGridBuilder)
+
+    df = _xor_df(spark, n=2500)
+    tree = DecisionTreeClassifier(["x1", "x2"], "label")
+    grid = (ParamGridBuilder()
+            .addGrid("max_depth", [1, 4])
+            .build())
+    cv = CrossValidator(tree, grid,
+                        MulticlassClassificationEvaluator(
+                            labelCol="label"),
+                        numFolds=3)
+    model = cv.fit(df)
+    # depth 1 cannot represent XOR; CV must pick depth 4
+    assert model.bestParams == {"max_depth": 4}, (
+        model.bestParams, cv.avg_metrics)
+    acc = MulticlassClassificationEvaluator(labelCol="label").evaluate(
+        model.transform(df))
+    assert acc > 0.9, acc
