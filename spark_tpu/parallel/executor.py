@@ -261,21 +261,34 @@ class MeshExecutor:
             from spark_tpu.physical.window import WindowExec
 
             child = self.plan(plan.child)
-            parts = [E.strip_alias(e).partition_by
-                     for e in plan.window_exprs]
             # exchanging on the key SET co-locates partitions for every
             # spec that uses the same keys in any order (the local
-            # operator re-groups per spec anyway)
-            keysets = {frozenset(E.expr_key(k) for k in p)
-                       for p in parts}
-            if len(keysets) != 1:
-                raise NotImplementedError(
-                    "distributed windows need one shared PARTITION BY "
-                    "key set across the SELECT's window expressions")
-            keys = parts[0]
-            ex = (D.HashPartitionExchangeExec(keys, child) if keys
-                  else D.SinglePartitionExchangeExec(child))
-            return WindowExec(plan.window_exprs, ex)
+            # operator re-groups per spec anyway). DIFFERENT key sets
+            # chain: one exchange + local window PER set, later stages
+            # running over the previous stage's output — the same
+            # cascade EnsureRequirements produces for mixed window
+            # specs (WindowExec.scala:87 ClusteredDistribution)
+            groups: list = []  # (frozen key set, keys, [exprs])
+            for e in plan.window_exprs:
+                p = E.strip_alias(e).partition_by
+                fs = frozenset(E.expr_key(k) for k in p)
+                for g in groups:
+                    if g[0] == fs:
+                        g[2].append(e)
+                        break
+                else:
+                    groups.append((fs, p, [e]))
+            cur = child
+            for _, keys, exprs in groups:
+                ex = (D.HashPartitionExchangeExec(tuple(keys), cur)
+                      if keys else D.SinglePartitionExchangeExec(cur))
+                cur = WindowExec(tuple(exprs), ex)
+            if len(groups) > 1:
+                # restore the logical output column order (window cols
+                # were appended per chained stage)
+                cur = P.ProjectExec(
+                    tuple(E.Col(n) for n in plan.schema.names), cur)
+            return cur
         raise NotImplementedError(
             f"no distributed plan for {type(plan).__name__}")
 
@@ -295,9 +308,12 @@ class MeshExecutor:
             key_sets = {tuple(E.expr_key(c) for c in a.children())
                         for a in distinct_aggs}
             if len(key_sets) > 1:
-                raise NotImplementedError(
-                    "multiple DISTINCT aggregates over different columns "
-                    "in a global aggregate are not supported yet")
+                # SPLIT per distinct child set (the reference rewrites
+                # through an Expand, RewriteDistinctAggregates.scala:1;
+                # here each set gets its OWN exchange+psum sub-aggregate
+                # and the 1-row results cross-join back together)
+                return self._plan_multi_distinct(groupings, aggregates,
+                                                 agg_calls, child)
             ex = D.HashPartitionExchangeExec(
                 tuple(distinct_aggs[0].children()), child)
             return D.PSumAggExec(groupings, aggregates, ex)
@@ -336,6 +352,37 @@ class MeshExecutor:
         # all their values) live on one device; local sort-agg is exact.
         ex = D.HashPartitionExchangeExec(tuple(groupings), child)
         return D.DistSortAggExec(groupings, aggregates, ex)
+
+    def _plan_multi_distinct(self, groupings, aggregates, agg_calls,
+                             child: P.PhysicalPlan) -> P.PhysicalPlan:
+        """Global aggregate mixing DISTINCT aggregates over DIFFERENT
+        columns (and any non-distinct aggregates): one exchange+psum
+        sub-aggregate per distinct child set, cross-joined 1-row
+        results, final projection restoring the output expressions
+        (reference: RewriteDistinctAggregates.scala:1 Expand rewrite)."""
+        from spark_tpu.physical.operators import rewrite_agg_outputs
+
+        outputs, _ = rewrite_agg_outputs(groupings, aggregates)
+        buckets: dict = {}  # child-key-set (or None) -> [(idx, call)]
+        for i, call in enumerate(agg_calls):
+            k = (tuple(E.expr_key(c) for c in call.children())
+                 if getattr(call, "distinct", False) else None)
+            buckets.setdefault(k, []).append((i, call))
+        sub_plans = []
+        for k, items in buckets.items():
+            aliases = tuple(E.Alias(call, f"__agg{i}")
+                            for i, call in items)
+            if k is None:
+                sub_plans.append(D.PSumAggExec((), aliases, child))
+            else:
+                ex = D.HashPartitionExchangeExec(
+                    tuple(items[0][1].children()), child)
+                sub_plans.append(D.PSumAggExec((), aliases, ex))
+        combined = sub_plans[0]
+        for sp in sub_plans[1:]:
+            combined = D.DistJoinBoundary(combined, sp, "cross",
+                                          (), (), None)
+        return P.ProjectExec(tuple(outputs), combined)
 
     def _shard_relation(self, batch) -> ShardedBatch:
         if isinstance(batch, ShardedBatch):
