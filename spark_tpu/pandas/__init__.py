@@ -13,6 +13,7 @@ mesh) only at materialization points (``to_pandas``, ``len``, ``head``).
     out.to_pandas()
 """
 
-from spark_tpu.pandas.frame import PsFrame, from_pandas, read_parquet
+from spark_tpu.pandas.frame import (PsFrame, concat, from_pandas,
+                                    read_parquet)
 
 __all__ = ["PsFrame", "from_pandas", "read_parquet"]

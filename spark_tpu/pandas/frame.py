@@ -227,6 +227,37 @@ class PsFrame:
         cols = [by] if isinstance(by, str) else list(by)
         return PsFrame(self._df.sort(*cols, ascending=ascending))
 
+    # -- indexing -------------------------------------------------------------
+
+    @property
+    def iloc(self) -> "_ILoc":
+        return _ILoc(self)
+
+    @property
+    def loc(self) -> "_Loc":
+        return _Loc(self)
+
+    # -- cleaning / ranking ---------------------------------------------------
+
+    def fillna(self, value, subset=None) -> "PsFrame":
+        return PsFrame(self._df.fillna(value, subset=subset))
+
+    def dropna(self, subset=None) -> "PsFrame":
+        return PsFrame(self._df.dropna(subset=subset))
+
+    def value_counts(self, col: str) -> "PsFrame":
+        from spark_tpu.api import functions as F
+
+        return PsFrame(self._df.groupBy(col)
+                       .agg(F.count("*").alias("count"))
+                       .sort("count", ascending=False))
+
+    def nlargest(self, n: int, col: str) -> "PsFrame":
+        return PsFrame(self._df.sort(col, ascending=False).limit(n))
+
+    def nsmallest(self, n: int, col: str) -> "PsFrame":
+        return PsFrame(self._df.sort(col, ascending=True).limit(n))
+
     # -- materialization ------------------------------------------------------
 
     def head(self, n: int = 5):
@@ -250,3 +281,88 @@ class PsFrame:
         import pandas as pd
 
         return pd.DataFrame(stats).set_index("statistic")
+
+
+class _ILoc:
+    """Positional row access: slices plan as limit/offset (no full
+    materialization); a bare int materializes one row (reference:
+    pyspark.pandas iLocIndexer)."""
+
+    def __init__(self, frame: "PsFrame"):
+        self._frame = frame
+
+    def __getitem__(self, key):
+        df = self._frame._df
+        if isinstance(key, slice):
+            if (key.step or 1) != 1:
+                raise NotImplementedError("iloc step slicing")
+            start = key.start or 0
+            if start < 0 or (key.stop is not None and key.stop < 0):
+                raise NotImplementedError("negative iloc bounds")
+            out = df.offset(start) if start else df
+            if key.stop is not None:
+                out = out.limit(max(0, key.stop - start))
+            return PsFrame(out)
+        if isinstance(key, int):
+            pdf = PsFrame(df.offset(key).limit(1)).to_pandas()
+            if not len(pdf):
+                raise IndexError(key)
+            return pdf.iloc[0]
+        raise TypeError(f"cannot iloc-index with {type(key).__name__}")
+
+
+class _Loc:
+    """Label/mask access: loc[mask], loc[mask, cols], loc[:, cols]
+    (reference: pyspark.pandas LocIndexer — the row-label forms that
+    need a materialized index are out of scope, like ps defaults with
+    distributed-sequence off)."""
+
+    def __init__(self, frame: "PsFrame"):
+        self._frame = frame
+
+    def __getitem__(self, key):
+        rows, cols = key if isinstance(key, tuple) else (key, None)
+        df = self._frame._df
+        if isinstance(rows, PsColumn):
+            df = df.filter(rows._expr)
+        elif not (isinstance(rows, slice) and rows.start is None
+                  and rows.stop is None):
+            raise NotImplementedError(
+                "loc supports boolean-mask rows or ':' (positional "
+                "label indexes are not materialized)")
+        if cols is not None:
+            names = [cols] if isinstance(cols, str) else list(cols)
+            df = df.select(*names)
+        return PsFrame(df)
+
+
+def concat(frames: Sequence["PsFrame"], ignore_index: bool = True
+           ) -> "PsFrame":
+    """Row-wise union by COLUMN NAME; a column missing from a frame
+    contributes NULLs (reference: pyspark.pandas.concat outer-align
+    behavior)."""
+    if not frames:
+        raise ValueError("concat of no frames")
+    all_cols: List[str] = []
+    for f in frames:
+        for c in f.columns:
+            if c not in all_cols:
+                all_cols.append(c)
+    dtypes = {}
+    for f in frames:
+        for fld in f._df.schema.fields:
+            dtypes.setdefault(fld.name, fld.dtype)
+    aligned = []
+    for f in frames:
+        df = f._df
+        missing = [c for c in all_cols if c not in f.columns]
+        for c in missing:
+            # typed NULL: the column's type comes from a frame that has it
+            df = df.withColumn(c, E.Literal(None, dtypes[c]))
+        aligned.append(df.select(*all_cols))
+    out = aligned[0]
+    for df in aligned[1:]:
+        out = out.unionByName(df)
+    return PsFrame(out)
+
+

@@ -80,3 +80,57 @@ def test_describe(pdf):
     d = f.describe()
     assert d.loc["count", "x"] == 6
     assert d.loc["max", "y"] == 6.5
+
+
+def test_iloc_and_loc(spark):
+    import spark_tpu.pandas as ps
+    import pandas as pd
+
+    psdf = ps.from_pandas(pd.DataFrame(
+        {"a": range(10), "b": [i * 2 for i in range(10)]}))
+    assert list(psdf.iloc[2:5].to_pandas()["a"]) == [2, 3, 4]
+    assert list(psdf.iloc[:3].to_pandas()["a"]) == [0, 1, 2]
+    row = psdf.iloc[4]
+    assert (row["a"], row["b"]) == (4, 8)
+    got = psdf.loc[psdf.a > 6, ["b"]].to_pandas()
+    assert list(got["b"]) == [14, 16, 18] and list(got.columns) == ["b"]
+    got2 = psdf.loc[:, ["a"]].to_pandas()
+    assert list(got2.columns) == ["a"] and len(got2) == 10
+
+
+def test_concat_aligns_columns(spark):
+    import spark_tpu.pandas as ps
+    import pandas as pd
+
+    a = ps.from_pandas(pd.DataFrame({"x": [1, 2], "y": [10.0, 20.0]}))
+    b = ps.from_pandas(pd.DataFrame({"x": [3], "z": [99.0]}))
+    out = ps.concat([a, b]).to_pandas()
+    assert list(out.columns) == ["x", "y", "z"]
+    assert list(out["x"]) == [1, 2, 3]
+    assert pd.isna(out["z"][0]) and out["z"][2] == 99.0
+    assert pd.isna(out["y"][2])
+
+
+def test_value_counts_and_ranking(spark):
+    import spark_tpu.pandas as ps
+    import pandas as pd
+
+    psdf = ps.from_pandas(pd.DataFrame(
+        {"k": ["a", "b", "a", "a", "c"], "v": [5, 3, 9, 1, 7]}))
+    vc = psdf.value_counts("k").to_pandas()
+    assert list(vc["k"])[0] == "a" and list(vc["count"])[0] == 3
+    assert list(psdf.nlargest(2, "v").to_pandas()["v"]) == [9, 7]
+    assert list(psdf.nsmallest(2, "v").to_pandas()["v"]) == [1, 3]
+
+
+def test_fillna_dropna(spark):
+    import spark_tpu.pandas as ps
+    import pandas as pd
+    import numpy as np
+
+    psdf = ps.from_pandas(pd.DataFrame(
+        {"a": [1.0, np.nan, 3.0], "b": [np.nan, 5.0, 6.0]}))
+    filled = psdf.fillna(0.0).to_pandas()
+    assert list(filled["a"]) == [1.0, 0.0, 3.0]
+    dropped = psdf.dropna().to_pandas()
+    assert len(dropped) == 1 and dropped["a"].iloc[0] == 3.0
