@@ -45,14 +45,27 @@ class ConnectServer:
                     self._send(404, b"not found", "text/plain")
 
             def do_POST(self):
-                if self.path != "/sql":
+                if self.path not in ("/sql", "/plan"):
                     self._send(404, b"not found", "text/plain")
                     return
                 n = int(self.headers.get("Content-Length", "0"))
                 try:
                     req = json.loads(self.rfile.read(n))
                     with outer._exec_lock:
-                        tbl = outer.session.sql(req["query"]).toArrow()
+                        if self.path == "/sql":
+                            df = outer.session.sql(req["query"])
+                        else:
+                            # typed logical-plan protocol (reference:
+                            # relations.proto decoded by
+                            # SparkConnectPlanner.scala:67)
+                            from spark_tpu.api.dataframe import DataFrame
+                            from spark_tpu.connect.proto import \
+                                decode_plan
+
+                            df = DataFrame(
+                                outer.session,
+                                decode_plan(req["plan"], outer.session))
+                        tbl = df.toArrow()
                     sink = io.BytesIO()
                     with pa.ipc.new_stream(sink, tbl.schema) as w:
                         w.write_table(tbl)
@@ -117,3 +130,125 @@ class Client:
 
         with urllib.request.urlopen(self.url + "/tables") as resp:
             return json.loads(resp.read())
+
+    def _execute_plan(self, plan: dict) -> pa.Table:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + "/plan",
+            data=json.dumps({"plan": plan}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = json.loads(e.read())
+            raise RuntimeError(
+                f"{detail.get('error')}: {detail.get('message')}") from None
+        return pa.ipc.open_stream(io.BytesIO(data)).read_all()
+
+    def table(self, name: str) -> "RemoteDataFrame":
+        """Lazy remote DataFrame over the typed plan protocol
+        (connect/proto.py; reference: relations.proto + the pyspark
+        connect client's plan builders)."""
+        return RemoteDataFrame(self, {"op": "read", "table": name})
+
+
+def col(name: str) -> dict:
+    return {"e": "col", "name": name}
+
+
+def lit(value, type_: str = None) -> dict:  # noqa: A002
+    out = {"e": "lit", "value": value}
+    if type_:
+        out["type"] = type_
+    return out
+
+
+def fn(name: str, *args, distinct: bool = False) -> dict:
+    out = {"e": "fn", "name": name,
+           "args": [_e(a) for a in args]}
+    if distinct:
+        out["distinct"] = True
+    return out
+
+
+def _e(x) -> dict:
+    if isinstance(x, dict):
+        return x
+    if isinstance(x, str):
+        return col(x)
+    return lit(x)
+
+
+def _alias(e: dict, name: str) -> dict:
+    return {"e": "alias", "name": name, "child": e}
+
+
+class RemoteDataFrame:
+    """Client-side lazy plan builder with NO engine imports — every
+    method appends a typed relation node; collect() ships the JSON plan
+    and reads back Arrow (the decoupled-client shape of
+    pyspark.sql.connect.dataframe.DataFrame)."""
+
+    def __init__(self, client: Client, plan: dict):
+        self._client = client
+        self._plan = plan
+
+    def filter(self, condition: dict) -> "RemoteDataFrame":
+        return RemoteDataFrame(self._client, {
+            "op": "filter", "condition": condition, "child": self._plan})
+
+    def select(self, *exprs) -> "RemoteDataFrame":
+        return RemoteDataFrame(self._client, {
+            "op": "project", "exprs": [_e(x) for x in exprs],
+            "child": self._plan})
+
+    def groupBy(self, *keys) -> "RemoteGroupedData":  # noqa: N802
+        return RemoteGroupedData(self, [_e(k) for k in keys])
+
+    def join(self, other: "RemoteDataFrame", on,
+             how: str = "inner") -> "RemoteDataFrame":
+        names = [on] if isinstance(on, str) else list(on)
+        return RemoteDataFrame(self._client, {
+            "op": "join", "how": how, "on": names,
+            "left": self._plan, "right": other._plan})
+
+    def sort(self, *exprs, ascending: bool = True) -> "RemoteDataFrame":
+        orders = [{"expr": _e(x), "asc": bool(ascending)}
+                  for x in exprs]
+        return RemoteDataFrame(self._client, {
+            "op": "sort", "orders": orders, "child": self._plan})
+
+    orderBy = sort
+
+    def limit(self, n: int, offset: int = 0) -> "RemoteDataFrame":
+        return RemoteDataFrame(self._client, {
+            "op": "limit", "n": int(n), "offset": int(offset),
+            "child": self._plan})
+
+    def union(self, other: "RemoteDataFrame") -> "RemoteDataFrame":
+        return RemoteDataFrame(self._client, {
+            "op": "union", "left": self._plan, "right": other._plan})
+
+    def distinct(self) -> "RemoteDataFrame":
+        return RemoteDataFrame(self._client,
+                               {"op": "distinct", "child": self._plan})
+
+    def toArrow(self) -> pa.Table:  # noqa: N802
+        return self._client._execute_plan(self._plan)
+
+    def collect(self):
+        return self.toArrow().to_pylist()
+
+
+class RemoteGroupedData:
+    def __init__(self, df: RemoteDataFrame, keys):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, **aliased) -> RemoteDataFrame:
+        aggs = [_alias(e, name) for name, e in aliased.items()]
+        return RemoteDataFrame(self._df._client, {
+            "op": "aggregate", "groupings": self._keys,
+            "aggregates": aggs, "child": self._df._plan})

@@ -31,3 +31,51 @@ def test_tables_and_errors(server):
     assert "conn_t" in c.tables()
     with pytest.raises(RuntimeError):
         c.sql("select * from does_not_exist")
+
+
+def test_typed_plan_protocol(spark):
+    """Decoupled client builds a typed JSON logical plan (no engine
+    imports) and the server decodes/executes it (reference:
+    relations.proto + SparkConnectPlanner.scala:67)."""
+    from spark_tpu.connect.server import (Client, ConnectServer, col,
+                                          fn, lit)
+
+    spark.createDataFrame(
+        [{"k": i % 3, "v": i, "s": "ab"[i % 2]} for i in range(30)]
+    ).createOrReplaceTempView("cp_t")
+    spark.createDataFrame(
+        [{"k": i, "w": i * 10} for i in range(3)]
+    ).createOrReplaceTempView("cp_d")
+
+    srv = ConnectServer(spark, port=0).start()
+    try:
+        c = Client(srv.url)
+        out = (c.table("cp_t")
+               .filter({"e": "bin", "op": ">", "left": col("v"),
+                        "right": lit(4)})
+               .groupBy("k")
+               .agg(n=fn("count", "v"),
+                    sv=fn("sum", "v"),
+                    ds=fn("count", "s", distinct=True))
+               .sort("k")
+               .toArrow())
+        rows = out.to_pylist()
+        assert [r["k"] for r in rows] == [0, 1, 2]
+        assert sum(r["n"] for r in rows) == 25
+        assert all(r["ds"] <= 2 for r in rows)
+
+        # join through the protocol (USING semantics: k appears once)
+        j = (c.table("cp_t").join(c.table("cp_d"), on="k")
+             .select("k", "v", "w").sort("v").limit(5).toArrow())
+        assert j.column_names == ["k", "v", "w"]
+        assert j.num_rows == 5
+        assert j.to_pylist()[0]["w"] == j.to_pylist()[0]["k"] * 10
+
+        # unknown function -> structured error
+        try:
+            c.table("cp_t").select(fn("no_such_fn", "v")).toArrow()
+            assert False, "expected error"
+        except RuntimeError as e:
+            assert "no_such_fn" in str(e)
+    finally:
+        srv.stop()
