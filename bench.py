@@ -108,7 +108,10 @@ def main():
     io_s = time.time() - t0
 
     results = {}
+    import sys
+
     for qnum in (1, 3, 5):
+        print(f"[bench] q{qnum} starting", file=sys.stderr, flush=True)
         df = spark.sql(QUERIES[qnum])
         lp = optimize(rewrite_subqueries(df._plan))
         nbytes = _query_bytes(lp, spark.conf)

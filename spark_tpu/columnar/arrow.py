@@ -230,7 +230,8 @@ def _list_to_padded(col: pa.ChunkedArray):
     return vals, lengths, validity, dictionary, el_dtype
 
 
-def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> Batch:
+def from_arrow(table: pa.Table, capacity: Optional[int] = None,
+               narrow_transfer: bool = False) -> Batch:
     """Arrow table -> device Batch (pads to bucketed capacity). List
     columns become padded-2D ArrayType columns plus a hidden '#len'
     companion; struct columns FLATTEN into dotted children (reference
@@ -297,7 +298,8 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None) -> Batch:
     for name, col in zip(table.column_names, table.columns):
         add(name, col)
     schema = Schema(tuple(fields))
-    return from_numpy(schema, arrays, validities, capacity=capacity)
+    return from_numpy(schema, arrays, validities, capacity=capacity,
+                      narrow_transfer=narrow_transfer)
 
 
 def schema_from_arrow(pa_schema: "pa.Schema") -> Schema:

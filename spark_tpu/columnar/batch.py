@@ -305,8 +305,16 @@ def from_numpy(
     arrays: Sequence[np.ndarray],
     validities: Optional[Sequence[Optional[np.ndarray]]] = None,
     capacity: Optional[int] = None,
+    narrow_transfer: bool = False,
 ) -> Batch:
-    """Build a device batch from host numpy columns, padding to capacity."""
+    """Build a device batch from host numpy columns, padding to capacity.
+
+    ``narrow_transfer`` ships int64 columns whose values fit int32 as
+    int32 — the stage runner widens them back at trace entry
+    (Pipe.from_batch_data), so the cast runs ON DEVICE and the
+    host->device link moves half the bytes. Built for tunneled TPUs
+    (~34 MB/s measured): the out-of-HBM tiers stream tens of GB
+    through this path."""
     n = int(arrays[0].shape[0]) if arrays else 0
     for a in arrays:
         assert a.shape[0] == n, "all columns must have equal length"
@@ -318,6 +326,12 @@ def from_numpy(
     cols = []
     for f, arr, val in zip(schema.fields, arrays, validities):
         np_dt = arr.dtype if arr.ndim > 1 else f.dtype.np_dtype
+        if narrow_transfer and arr.ndim == 1 \
+                and np.dtype(np_dt) == np.int64 and n > 0:
+            lo = int(arr.min()) if n else 0
+            hi = int(arr.max()) if n else 0
+            if -(1 << 31) <= lo and hi < (1 << 31):
+                np_dt = np.int32
         shape = (cap,) + tuple(arr.shape[1:])
         padded = np.zeros(shape, dtype=np_dt)
         padded[:n] = arr.astype(np_dt, copy=False)

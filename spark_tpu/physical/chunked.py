@@ -343,6 +343,36 @@ class _HostKeyFilter:
                 E.Cmp("<=", E.Col(self.col), E.Literal(hi))]
 
 
+def _chunk_capacity(rows: int, cap_max: int) -> int:
+    """Power-of-two capacity bucket in [2^16, cap_max]: at most ~12
+    distinct compiled programs across a whole stream, while a heavily
+    key-filtered chunk ships proportional to its SURVIVING rows (the
+    tunnel to a remote TPU is bandwidth-bound; a fixed capacity padded
+    every chunk to the maximum)."""
+    cap = 1 << 16
+    while cap < rows:
+        cap <<= 1
+    return min(cap, cap_max) if rows <= cap_max else cap_max
+
+
+def _progress_logger(tag: str):
+    """stderr progress lines when SPARK_TPU_PROGRESS is set — hour-long
+    SF100 streams are otherwise a black box from outside."""
+    import os
+    import sys
+    import time
+
+    if not os.environ.get("SPARK_TPU_PROGRESS"):
+        return lambda *_: None
+    t0 = time.time()
+
+    def log(chunks: int, rows: int) -> None:
+        print(f"[{tag}] chunk={chunks} rows={rows} "
+              f"t={time.time() - t0:.0f}s", file=sys.stderr, flush=True)
+
+    return log
+
+
 def _empty_rel(scan: L.UnresolvedScan) -> L.Relation:
     from spark_tpu.columnar.arrow import from_arrow
     from spark_tpu.io.datasource import _pa_schema_from_schema
@@ -386,7 +416,9 @@ class _ChunkedAgg:
         # 1. materialize each sidecar ONCE; they stay device-resident
         sidecar_rel: Dict[int, L.LogicalPlan] = {}
         filters: List[_HostKeyFilter] = []
-        for pj in self.path_joins:
+        side_log = _progress_logger("sidecar")
+        for si, pj in enumerate(self.path_joins):
+            side_log(si, 0)
             batch = run_fn(pj.sidecar)
             sidecar_rel[id(pj.sidecar)] = L.Relation(batch)
             if (exact_max > 0 and pj.can_filter
@@ -440,8 +472,10 @@ class _ChunkedAgg:
 
         state = _MergeState(merge_plan, run_fn)
         rows_in = rows_kept = 0
+        progress = _progress_logger("chunked_agg")
         for tbl in scan.source.iter_batches(read_cols, scan_filters,
                                             chunk_rows):
+            progress(state.chunks, rows_in)
             rows_in += tbl.num_rows
             if filters:
                 keep = np.ones(tbl.num_rows, dtype=bool)
@@ -461,8 +495,10 @@ class _ChunkedAgg:
             rows_kept += tbl.num_rows
             chunk_plan = _splice(
                 skeleton,
-                {id(scan): L.Relation(from_arrow(tbl,
-                                                 capacity=fixed_cap))})
+                {id(scan): L.Relation(from_arrow(
+                    tbl,
+                    capacity=_chunk_capacity(tbl.num_rows, fixed_cap),
+                    narrow_transfer=True))})
             partial = L.Aggregate(tuple(spec.groupings_exec),
                                   key_aliases + tuple(spec.partials),
                                   chunk_plan)
@@ -605,10 +641,10 @@ class _GraceHashAgg:
             tb = concat(buckets_b[p], self.scan_b)
             buckets_a[p] = buckets_b[p] = None  # free host RAM as we go
             chunk_plan = _splice(self.agg.child, {
-                id(self.scan_a): L.Relation(from_arrow(ta,
-                                                       capacity=cap_a)),
-                id(self.scan_b): L.Relation(from_arrow(tb,
-                                                       capacity=cap_b))})
+                id(self.scan_a): L.Relation(from_arrow(
+                    ta, capacity=cap_a, narrow_transfer=True)),
+                id(self.scan_b): L.Relation(from_arrow(
+                    tb, capacity=cap_b, narrow_transfer=True))})
             partial = L.Aggregate(tuple(spec.groupings_exec),
                                   key_aliases + tuple(spec.partials),
                                   chunk_plan)
@@ -669,8 +705,10 @@ class _ChunkedTopK:
                 continue
             chunk_plan = _splice(
                 self.chain_root,
-                {id(self.big): L.Relation(from_arrow(tbl,
-                                                     capacity=fixed_cap))})
+                {id(self.big): L.Relation(from_arrow(
+                    tbl,
+                    capacity=_chunk_capacity(tbl.num_rows, fixed_cap),
+                    narrow_transfer=True))})
             state.feed(chunk_plan)
         metrics.record("chunked_topk", chunks=state.chunks, k=k)
 

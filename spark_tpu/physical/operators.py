@@ -57,7 +57,15 @@ class Pipe:
     def from_batch_data(cls, schema: Schema, data: BatchData) -> "Pipe":
         cols = {}
         for f, cd in zip(schema.fields, data.columns):
-            cols[f.name] = TV(cd.data, cd.validity, f.dtype, f.dictionary)
+            d = cd.data
+            want = C._jnp_dtype(f.dtype)
+            if d.ndim == 1 and d.dtype != want \
+                    and jnp.issubdtype(d.dtype, jnp.integer) \
+                    and jnp.issubdtype(want, jnp.integer):
+                # transfer-narrowed column (batch.from_numpy
+                # narrow_transfer): widen back ON DEVICE at trace entry
+                d = d.astype(want)
+            cols[f.name] = TV(d, cd.validity, f.dtype, f.dictionary)
         return cls(cols, data.row_mask, schema.names)
 
     def to_batch(self) -> Batch:
