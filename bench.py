@@ -29,8 +29,10 @@ These deliberately favour Spark; treat vs_baseline as indicative, the
 absolute ms as the record.
 """
 
+import contextlib
 import json
 import os
+import signal
 
 import time
 
@@ -44,6 +46,48 @@ N_ITER = int(os.environ.get("BENCH_ITERS", "5" if SF <= 10 else "1"))
 # target metric is the full suite; q1/q3/q5 stay the headline line)
 FULL = os.environ.get("BENCH_FULL", "0") == "1"
 HBM_GBPS = 819.0  # v5e peak HBM bandwidth; v5p is higher, so safe bound
+
+# Per-query wall-clock cap. A query that hangs (or an SF that turns out
+# to be hours of parquet IO) records {"error": "timeout"} and the run
+# moves on — the final JSON stays valid and covers every other query,
+# instead of the whole process dying to the harness's timeout(1) with
+# no parseable output at all.
+QUERY_TIMEOUT_S = float(os.environ.get("BENCH_QUERY_TIMEOUT",
+                                       "600" if SF <= 10 else "1200"))
+# Snapshot written after every query so even a SIGKILL leaves the
+# completed queries' numbers on disk.
+PARTIAL_PATH = os.environ.get("BENCH_PARTIAL_PATH", "BENCH_partial.json")
+
+
+class _QueryTimeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def _deadline(seconds: float):
+    """Raise _QueryTimeout in the main thread after ``seconds``."""
+    if seconds <= 0 or not hasattr(signal, "setitimer"):
+        yield
+        return
+
+    def _alarm(signum, frame):
+        raise _QueryTimeout(f"query exceeded {seconds:.0f}s")
+
+    prev = signal.signal(signal.SIGALRM, _alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _snapshot(payload: dict) -> None:
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            json.dump(payload, f)
+    except OSError:
+        pass
 
 # documented Spark CPU local[*] SF1 estimates (see module docstring)
 BASELINE_MS = {1: 900.0, 3: 700.0, 5: 1100.0}
@@ -112,75 +156,28 @@ def main():
 
     for qnum in (1, 3, 5):
         print(f"[bench] q{qnum} starting", file=sys.stderr, flush=True)
-        df = spark.sql(QUERIES[qnum])
-        lp = optimize(rewrite_subqueries(df._plan))
-        nbytes = _query_bytes(lp, spark.conf)
+        try:
+            with _deadline(QUERY_TIMEOUT_S):
+                results[qnum] = _run_headline(spark, qnum)
+        except _QueryTimeout as e:
+            print(f"[bench] q{qnum} TIMED OUT: {e}",
+                  file=sys.stderr, flush=True)
+            results[qnum] = {"error": "timeout",
+                             "timeout_s": QUERY_TIMEOUT_S}
+        except Exception as e:  # record, don't kill the other queries
+            print(f"[bench] q{qnum} FAILED: {e}",
+                  file=sys.stderr, flush=True)
+            results[qnum] = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()}})
 
-        if SF <= 10:
-            t0 = time.time()
-            rows1 = df.collect()  # warm-up 1: compiles + read + stats
-            rows = df.collect()  # warm-up 2: adaptive join stats bound —
-            # PK-FK joins fuse into one XLA program; compiles it
-            warm_s = time.time() - t0
-            assert rows, f"q{qnum} returned no rows"
-            # cross-path parity: the first (blocking) execution and the
-            # adaptive traced replay must produce the same result set
-            # (the full vs-sqlite oracle parity runs in
-            # tests/test_tpch.py at a smaller SF; this guards the fast
-            # path at BENCH scale)
-            assert len(rows1) == len(rows), \
-                f"q{qnum}: traced row count differs"
-            for a, b in zip(rows1, rows):
-                a = a.asDict() if hasattr(a, "asDict") else a
-                b = b.asDict() if hasattr(b, "asDict") else b
-                for x, y in zip(a.values(), b.values()):
-                    if isinstance(x, float):
-                        assert abs(x - y) <= 1e-6 * max(1.0, abs(x)), \
-                            f"q{qnum}: traced value drift {x} vs {y}"
-                    else:
-                        assert x == y, \
-                            f"q{qnum}: traced mismatch {x} vs {y}"
-
-            times = []
-            for _ in range(N_ITER):
-                t0 = time.perf_counter()
-                rows = df.collect()
-                times.append((time.perf_counter() - t0) * 1000.0)
-        else:
-            # out-of-HBM scale: every pass re-streams the dataset, so
-            # the first (and only, unless BENCH_ITERS>1) pass IS the
-            # honest number — compile time amortizes across hundreds of
-            # chunk dispatches inside it
-            warm_s = 0.0
-            times = []
-            for _ in range(N_ITER):
-                t0 = time.perf_counter()
-                rows = df.collect()
-                times.append((time.perf_counter() - t0) * 1000.0)
-            assert rows, f"q{qnum} returned no rows"
-        ms = float(np.median(times))
-        gbps = nbytes / (ms / 1e3) / 1e9
-        assert gbps < HBM_GBPS, (
-            f"q{qnum}: implied {gbps:.0f} GB/s exceeds HBM bandwidth "
-            f"({HBM_GBPS} GB/s) — benchmark is measuring a constant")
-        results[qnum] = {
-            "ms": round(ms, 1),
-            "min_ms": round(min(times), 1),
-            "warmup_s": round(warm_s, 1),
-            "rows": len(rows),
-            "scan_gb": round(nbytes / 1e9, 3),
-            "implied_gbps": round(gbps, 1),
-            "vs_spark_cpu_est": round(BASELINE_MS[qnum] * SF / ms, 2),
-        }
 
     full = {}
     if FULL:
-        import sys
-
         budget_s = float(os.environ.get("BENCH_FULL_BUDGET", "1800"))
         sweep_t0 = time.time()
         for qnum in sorted(QUERIES):
-            if qnum in results:
+            if qnum in results and "ms" in results[qnum]:
                 full[qnum] = results[qnum]["ms"]
                 continue
             elapsed = time.time() - sweep_t0
@@ -190,20 +187,31 @@ def main():
             print(f"[bench] q{qnum} (sweep {elapsed:.0f}s)",
                   file=sys.stderr, flush=True)
             try:
-                df = spark.sql(QUERIES[qnum])
-                df.collect()  # warm-up 1: compile + stats
-                df.collect()  # warm-up 2: adaptive stats bound
-                times = []
-                for _ in range(max(2, N_ITER // 2)):
-                    t0 = time.perf_counter()
-                    df.collect()
-                    times.append((time.perf_counter() - t0) * 1000.0)
-                full[qnum] = round(float(np.median(times)), 1)
+                with _deadline(QUERY_TIMEOUT_S):
+                    df = spark.sql(QUERIES[qnum])
+                    df.collect()  # warm-up 1: compile + stats
+                    df.collect()  # warm-up 2: adaptive stats bound
+                    times = []
+                    for _ in range(max(2, N_ITER // 2)):
+                        t0 = time.perf_counter()
+                        df.collect()
+                        times.append((time.perf_counter() - t0) * 1000.0)
+                    full[qnum] = round(float(np.median(times)), 1)
+            except _QueryTimeout:
+                full[qnum] = f"error: timeout after {QUERY_TIMEOUT_S:.0f}s"
             except Exception as e:  # record, don't kill the headline
                 full[qnum] = f"error: {type(e).__name__}: {e}"
+            _snapshot({"partial": True, "sf": SF,
+                       "queries": {str(k): v for k, v in results.items()},
+                       "all22_ms": {str(k): v for k, v in full.items()}})
 
-    total_ms = sum(r["ms"] for r in results.values())
-    vs = sum(BASELINE_MS.values()) * SF / total_ms
+    # totals cover the queries that finished; failed/timed-out ones are
+    # reported per-query and excluded so the JSON stays valid and the
+    # headline number stays meaningful (flagged via queries_failed)
+    ok = {q: r for q, r in results.items() if "ms" in r}
+    total_ms = sum(r["ms"] for r in ok.values())
+    vs = (sum(BASELINE_MS[q] for q in ok) * SF / total_ms
+          if total_ms else 0.0)
     print(json.dumps({
         "metric": f"tpch_sf{SF:g}_q1q3q5_total",
         "value": round(total_ms, 1),
@@ -212,6 +220,8 @@ def main():
         "platform": platform,
         "sf": SF,
         "iters": N_ITER,
+        "query_timeout_s": QUERY_TIMEOUT_S,
+        "queries_failed": sorted(q for q in results if q not in ok),
         "gen_s": round(gen_s, 1),
         "parquet_io_s": round(io_s, 1),
         "baseline": "Spark CPU local[*] SF1 estimate (see bench.py docstring)",
@@ -219,6 +229,73 @@ def main():
         **({"all22_ms": {str(k): v for k, v in full.items()}}
            if full else {}),
     }))
+
+
+def _run_headline(spark, qnum: int) -> dict:
+    from spark_tpu.plan.optimizer import optimize
+    from spark_tpu.plan.subquery import rewrite_subqueries
+    from spark_tpu.tpch.queries import QUERIES
+
+    df = spark.sql(QUERIES[qnum])
+    lp = optimize(rewrite_subqueries(df._plan))
+    nbytes = _query_bytes(lp, spark.conf)
+
+    if SF <= 10:
+        t0 = time.time()
+        rows1 = df.collect()  # warm-up 1: compiles + read + stats
+        rows = df.collect()  # warm-up 2: adaptive join stats bound —
+        # PK-FK joins fuse into one XLA program; compiles it
+        warm_s = time.time() - t0
+        assert rows, f"q{qnum} returned no rows"
+        # cross-path parity: the first (blocking) execution and the
+        # adaptive traced replay must produce the same result set
+        # (the full vs-sqlite oracle parity runs in
+        # tests/test_tpch.py at a smaller SF; this guards the fast
+        # path at BENCH scale)
+        assert len(rows1) == len(rows), \
+            f"q{qnum}: traced row count differs"
+        for a, b in zip(rows1, rows):
+            a = a.asDict() if hasattr(a, "asDict") else a
+            b = b.asDict() if hasattr(b, "asDict") else b
+            for x, y in zip(a.values(), b.values()):
+                if isinstance(x, float):
+                    assert abs(x - y) <= 1e-6 * max(1.0, abs(x)), \
+                        f"q{qnum}: traced value drift {x} vs {y}"
+                else:
+                    assert x == y, \
+                        f"q{qnum}: traced mismatch {x} vs {y}"
+
+        times = []
+        for _ in range(N_ITER):
+            t0 = time.perf_counter()
+            rows = df.collect()
+            times.append((time.perf_counter() - t0) * 1000.0)
+    else:
+        # out-of-HBM scale: every pass re-streams the dataset, so
+        # the first (and only, unless BENCH_ITERS>1) pass IS the
+        # honest number — compile time amortizes across hundreds of
+        # chunk dispatches inside it
+        warm_s = 0.0
+        times = []
+        for _ in range(N_ITER):
+            t0 = time.perf_counter()
+            rows = df.collect()
+            times.append((time.perf_counter() - t0) * 1000.0)
+        assert rows, f"q{qnum} returned no rows"
+    ms = float(np.median(times))
+    gbps = nbytes / (ms / 1e3) / 1e9
+    assert gbps < HBM_GBPS, (
+        f"q{qnum}: implied {gbps:.0f} GB/s exceeds HBM bandwidth "
+        f"({HBM_GBPS} GB/s) — benchmark is measuring a constant")
+    return {
+        "ms": round(ms, 1),
+        "min_ms": round(min(times), 1),
+        "warmup_s": round(warm_s, 1),
+        "rows": len(rows),
+        "scan_gb": round(nbytes / 1e9, 3),
+        "implied_gbps": round(gbps, 1),
+        "vs_spark_cpu_est": round(BASELINE_MS[qnum] * SF / ms, 2),
+    }
 
 
 if __name__ == "__main__":

@@ -697,4 +697,9 @@ def replace(c: ColumnOrName, find: str, replacement: str) -> Column:
     """Literal substring replacement (reference: StringReplace)."""
     import re as _re
 
-    return E.RegexpReplace(_c(c), _re.escape(str(find)), str(replacement))
+    # Only backslash is special in a re.sub replacement template (it
+    # introduces \1 backreferences and \g<> groups); escape it so the
+    # replacement is inserted literally. re.escape would be wrong here:
+    # it targets pattern syntax and would leak extra backslashes.
+    return E.RegexpReplace(_c(c), _re.escape(str(find)),
+                           str(replacement).replace("\\", "\\\\"))

@@ -230,12 +230,14 @@ def _list_to_padded(col: pa.ChunkedArray):
     return vals, lengths, validity, dictionary, el_dtype
 
 
-def from_arrow(table: pa.Table, capacity: Optional[int] = None,
-               narrow_transfer: bool = False) -> Batch:
-    """Arrow table -> device Batch (pads to bucketed capacity). List
-    columns become padded-2D ArrayType columns plus a hidden '#len'
-    companion; struct columns FLATTEN into dotted children (reference
-    peers: UnsafeArrayData / nested schema pruning)."""
+def arrow_to_numpy(table: pa.Table):
+    """Arrow table -> (Schema, host arrays, validities): the host half
+    of ``from_arrow``, exposed separately so the out-of-HBM pipeline
+    producer can stage arrow decode and device upload as independently
+    timed stages (physical/pipeline.py). List columns become padded-2D
+    ArrayType columns plus a hidden '#len' companion; struct columns
+    FLATTEN into dotted children (reference peers: UnsafeArrayData /
+    nested schema pruning)."""
     fields = []
     arrays = []
     validities = []
@@ -297,7 +299,14 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None,
 
     for name, col in zip(table.column_names, table.columns):
         add(name, col)
-    schema = Schema(tuple(fields))
+    return Schema(tuple(fields)), arrays, validities
+
+
+def from_arrow(table: pa.Table, capacity: Optional[int] = None,
+               narrow_transfer: bool = False) -> Batch:
+    """Arrow table -> device Batch (pads to bucketed capacity); see
+    ``arrow_to_numpy`` for the host-side column conversion rules."""
+    schema, arrays, validities = arrow_to_numpy(table)
     return from_numpy(schema, arrays, validities, capacity=capacity,
                       narrow_transfer=narrow_transfer)
 

@@ -96,6 +96,32 @@ class Batch:
     def __repr__(self) -> str:
         return f"Batch({self.schema}, capacity={self.capacity})"
 
+    def device_nbytes(self) -> int:
+        """Device bytes held by this batch (values + validity + mask) —
+        the unit of the out-of-HBM prefetch byte budget."""
+        total = self.data.row_mask.size * self.data.row_mask.dtype.itemsize
+        for cd in self.data.columns:
+            total += cd.data.size * cd.data.dtype.itemsize
+            if cd.validity is not None:
+                total += cd.validity.size * cd.validity.dtype.itemsize
+        return int(total)
+
+    def block_until_ready(self) -> "Batch":
+        """Wait for all pending host->device transfers of this batch's
+        arrays. The pipeline producer calls this so a chunk's transfer
+        completes on the PRODUCER thread (overlapped with the consumer's
+        device compute) instead of lazily serializing into the
+        consumer's next dispatch."""
+        try:
+            self.data.row_mask.block_until_ready()
+            for cd in self.data.columns:
+                cd.data.block_until_ready()
+                if cd.validity is not None:
+                    cd.validity.block_until_ready()
+        except (AttributeError, RuntimeError):
+            pass  # non-jax arrays (tests) or deleted buffers
+        return self
+
     # ---- host materialization -------------------------------------------
 
     def fetch_host(self):

@@ -95,6 +95,25 @@ EVENT_LOG_DIR = register(
     "When set, per-stage execution events are appended as JSONL under "
     "this directory (reference: EventLoggingListener.scala:48).", str)
 
+PIPELINE_DEPTH = register(
+    "spark.tpu.pipelineDepth", 2,
+    "Out-of-HBM chunk pipeline depth: how many prepared chunks the "
+    "background producer (parquet decode + host key filter + "
+    "host->device transfer) may run ahead of device compute. 0 runs "
+    "the fully serial decode->filter->ship->compute loop; >=1 "
+    "overlaps the stages (the ShuffleBlockFetcherIterator in-flight "
+    "window, applied to the host->device tunnel). Results are "
+    "byte-identical at every depth: chunks are consumed in source "
+    "order, so the device merge order never changes.", int)
+
+PREFETCH_BYTES_MAX = register(
+    "spark.tpu.prefetchBytesMax", 1 << 30,
+    "Byte cap on prepared-but-unconsumed pipeline chunks (device bytes "
+    "of in-flight prefetch). The producer stalls once in-flight bytes "
+    "reach this, whatever the pipeline depth, so prefetch can never "
+    "blow host RAM or HBM. At least one chunk is always admitted "
+    "(no deadlock on a budget smaller than a single chunk).", int)
+
 
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""

@@ -37,6 +37,26 @@ _TYPES = {
     "timestamp": T.TIMESTAMP,
 }
 
+#: Scalar functions a remote client may call by name. An explicit
+#: allowlist, not getattr-on-module: the function registry is the wire
+#: protocol surface, and module attributes that happen to be callable
+#: (helpers, imports, session-side constructors) are not part of it.
+_SCALAR_FNS = frozenset({
+    "abs", "coalesce", "isnull", "isnotnull", "floor", "ceil", "sqrt",
+    "exp", "log", "log2", "log10", "signum", "round", "pow", "pmod",
+    "degrees", "radians", "negative", "positive",
+    "upper", "lower", "trim", "ltrim", "rtrim", "length", "initcap",
+    "reverse", "repeat", "lpad", "rpad", "translate", "concat",
+    "concat_ws", "substring", "startswith", "endswith", "contains",
+    "like", "rlike", "regexp_extract", "regexp_replace", "replace",
+    "split",
+    "year", "month", "dayofmonth", "quarter", "dayofweek", "weekday",
+    "dayofyear", "hour", "minute", "second", "add_months", "date_add",
+    "date_sub", "datediff", "months_between", "to_date", "date_trunc",
+    "last_day",
+    "greatest", "least", "ifnull", "nvl2", "nullif",
+})
+
 
 def decode_expr(obj: Dict[str, Any]) -> E.Expression:
     kind = obj.get("e")
@@ -81,10 +101,9 @@ def decode_expr(obj: Dict[str, Any]) -> E.Expression:
             return cls(args[0], distinct=bool(obj.get("distinct")))
         from spark_tpu.api import functions as F
 
-        fn = getattr(F, name, None)
-        if fn is None or name.startswith("_"):
+        if name not in _SCALAR_FNS:
             raise ValueError(f"unknown function {obj['name']!r}")
-        return fn(*args)
+        return getattr(F, name)(*args)
     raise ValueError(f"unknown expression node {kind!r}")
 
 
@@ -114,15 +133,25 @@ def decode_plan(obj: Dict[str, Any], session) -> L.LogicalPlan:
         keys = tuple(E.Col(n) for n in names)
         how = obj.get("how", "inner")
         joined = L.Join(left, right, how, keys, keys)
-        # USING semantics: key columns appear once (from the left);
-        # right-side output names map positionally onto right's schema
+        # USING semantics: key columns appear once; output names map
+        # positionally onto each side's schema. For a RIGHT join the
+        # key values must come from the RIGHT side — unmatched right
+        # rows carry NULL in the left region — surfaced under the
+        # left's (un-suffixed) output name.
         if names and how in ("inner", "left", "right"):
             ln = len(left.schema.names)
+            lout = list(joined.schema.names)[:ln]
             rout = list(joined.schema.names)[ln:]
-            keep = list(joined.schema.names)[:ln] + [
-                o for o, src in zip(rout, right.schema.names)
-                if src not in names]
-            return L.Project(tuple(E.Col(n) for n in keep), joined)
+            rmap = dict(zip(right.schema.names, rout))
+            exprs = []
+            for o, src in zip(lout, left.schema.names):
+                if how == "right" and src in names:
+                    exprs.append(E.Alias(E.Col(rmap[src]), o))
+                else:
+                    exprs.append(E.Col(o))
+            exprs.extend(E.Col(o) for o, src in zip(rout, right.schema.names)
+                         if src not in names)
+            return L.Project(tuple(exprs), joined)
         return joined
     if op == "sort":
         orders = tuple(

@@ -92,6 +92,117 @@ def reset() -> None:
         _QUERY_MARKS.clear()
 
 
+class PipelineStats:
+    """Wall-time accounting for the out-of-HBM chunk pipeline
+    (physical/pipeline.py): per-stage totals (decode / filter /
+    transfer / compute), producer/consumer stall counters, and a
+    DIRECTLY MEASURED overlap — the wall time during which a producer
+    stage (decode/filter/transfer) and a consumer stage
+    (compute/sidecar) were simultaneously in flight. Summing per-stage
+    totals and subtracting wall time would mis-report overlap when
+    stages interleave with stalls; the concurrency clock counts exactly
+    the seconds the pipeline actually hid behind device compute."""
+
+    PRODUCER_STAGES = ("decode", "filter", "transfer")
+    CONSUMER_STAGES = ("compute", "sidecar")
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._ms: Dict[str, float] = {}
+        self._active = {"producer": 0, "consumer": 0}
+        self._both_since: Optional[float] = None
+        self._overlap_s = 0.0
+        self.max_inflight_bytes = 0
+        self.max_inflight_chunks = 0
+
+    def add(self, stage: str, ms: float) -> None:
+        with self._lock:
+            self._ms[stage] = self._ms.get(stage, 0.0) + ms
+
+    def timed(self, stage: str):
+        return _PipelineStageTimer(self, stage)
+
+    def _enter(self, role: str) -> None:
+        with self._lock:
+            self._active[role] += 1
+            if (self._both_since is None
+                    and all(self._active.values())):
+                self._both_since = time.perf_counter()
+
+    def _exit(self, role: str) -> None:
+        with self._lock:
+            self._active[role] -= 1
+            if self._both_since is not None \
+                    and not all(self._active.values()):
+                self._overlap_s += time.perf_counter() - self._both_since
+                self._both_since = None
+
+    def note_inflight(self, nbytes: int, chunks: int) -> None:
+        with self._lock:
+            self.max_inflight_bytes = max(self.max_inflight_bytes,
+                                          int(nbytes))
+            self.max_inflight_chunks = max(self.max_inflight_chunks,
+                                           int(chunks))
+
+    def overlap_ms(self) -> float:
+        with self._lock:
+            s = self._overlap_s
+            if self._both_since is not None:
+                s += time.perf_counter() - self._both_since
+        return s * 1e3
+
+    def finish(self) -> Dict[str, Any]:
+        """Close the clock and return the event fields to splat into
+        ``record(...)``."""
+        wall_ms = (time.perf_counter() - self._t0) * 1e3
+        overlap = self.overlap_ms()
+        with self._lock:
+            ms = dict(self._ms)
+        out: Dict[str, Any] = {
+            f"{s}_ms": round(ms.get(s, 0.0), 2)
+            for s in ("decode", "filter", "transfer", "compute")}
+        if ms.get("sidecar"):
+            out["sidecar_ms"] = round(ms["sidecar"], 2)
+        out["wall_ms"] = round(wall_ms, 2)
+        out["overlap_ms"] = round(overlap, 2)
+        out["overlap_ratio"] = round(overlap / wall_ms, 4) if wall_ms \
+            else 0.0
+        out["stall_producer_ms"] = round(ms.get("stall_producer", 0.0), 2)
+        out["stall_consumer_ms"] = round(ms.get("stall_consumer", 0.0), 2)
+        out["max_inflight_bytes"] = self.max_inflight_bytes
+        out["max_inflight_chunks"] = self.max_inflight_chunks
+        return out
+
+
+class _PipelineStageTimer:
+    """Context manager: one timed pipeline-stage region, feeding both
+    the per-stage total and the producer/consumer concurrency clock."""
+
+    def __init__(self, stats: PipelineStats, stage: str):
+        self._stats = stats
+        self._stage = stage
+        if stage in PipelineStats.PRODUCER_STAGES:
+            self._role: Optional[str] = "producer"
+        elif stage in PipelineStats.CONSUMER_STAGES:
+            self._role = "consumer"
+        else:
+            self._role = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        if self._role is not None:
+            self._stats._enter(self._role)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._role is not None:
+            self._stats._exit(self._role)
+        self._stats.add(self._stage,
+                        (time.perf_counter() - self._t0) * 1e3)
+        return False
+
+
 class stage_timer:
     """Context manager recording one stage execution event."""
 

@@ -41,6 +41,12 @@ spill-tier shape of its build), TungstenAggregationIterator.scala:82
 sort-merge fallback — except the reference spills mid-operator, while
 here the operator is re-planned as a merge over chunk partials (the
 map-side-combine shape of AggUtils).
+
+All three tiers stream through the asynchronous chunk pipeline
+(physical/pipeline.py, ``spark.tpu.pipelineDepth``): a background
+producer decodes, host-filters, and ships the next chunks while the
+device merges the previous partials — chunks are always consumed in
+source order, so results are byte-identical at every depth.
 """
 
 from __future__ import annotations
@@ -357,18 +363,27 @@ def _chunk_capacity(rows: int, cap_max: int) -> int:
 
 def _progress_logger(tag: str):
     """stderr progress lines when SPARK_TPU_PROGRESS is set — hour-long
-    SF100 streams are otherwise a black box from outside."""
+    SF100 streams are otherwise a black box from outside. When the
+    chunk pipeline's stats are passed, each line also reports the
+    achieved decode/transfer-vs-compute overlap so the operator can see
+    whether prefetch is actually hiding the tunnel."""
     import os
     import sys
     import time
 
     if not os.environ.get("SPARK_TPU_PROGRESS"):
-        return lambda *_: None
+        return lambda *_, **__: None
     t0 = time.time()
 
-    def log(chunks: int, rows: int) -> None:
+    def log(chunks: int, rows: int, stats=None) -> None:
+        elapsed = time.time() - t0
+        extra = ""
+        if stats is not None:
+            ov_s = stats.overlap_ms() / 1e3
+            pct = 100.0 * ov_s / elapsed if elapsed > 0 else 0.0
+            extra = f" overlap={ov_s:.1f}s ({pct:.0f}%)"
         print(f"[{tag}] chunk={chunks} rows={rows} "
-              f"t={time.time() - t0:.0f}s", file=sys.stderr, flush=True)
+              f"t={elapsed:.0f}s{extra}", file=sys.stderr, flush=True)
 
     return log
 
@@ -400,8 +415,9 @@ class _ChunkedAgg:
 
     def execute(self, conf, run_fn):
         from spark_tpu import metrics
-        from spark_tpu.columnar.arrow import from_arrow
-        from spark_tpu.columnar.batch import round_capacity
+        from spark_tpu.columnar.arrow import arrow_to_numpy
+        from spark_tpu.columnar.batch import from_numpy, round_capacity
+        from spark_tpu.physical.pipeline import ChunkPipeline
 
         agg, scan = self.agg, self.big
         spec = AggSpec(agg.groupings, agg.aggregates)
@@ -412,103 +428,163 @@ class _ChunkedAgg:
         # a fresh XLA compile per chunk (~minutes each on TPU)
         fixed_cap = round_capacity(chunk_rows)
         exact_max = conf.get(SEMI_FILTER_EXACT_MAX)
+        depth = conf.get(CF.PIPELINE_DEPTH)
+        prefetch_budget = conf.get(CF.PREFETCH_BYTES_MAX)
+        stats = metrics.PipelineStats()
 
-        # 1. materialize each sidecar ONCE; they stay device-resident
-        sidecar_rel: Dict[int, L.LogicalPlan] = {}
-        filters: List[_HostKeyFilter] = []
-        side_log = _progress_logger("sidecar")
-        for si, pj in enumerate(self.path_joins):
-            side_log(si, 0)
-            batch = run_fn(pj.sidecar)
-            sidecar_rel[id(pj.sidecar)] = L.Relation(batch)
-            if (exact_max > 0 and pj.can_filter
-                    and len(pj.big_keys) == 1):
+        # plan-only pre-pass: which path joins COULD yield a host key
+        # filter. When none can, the chunk producer starts BEFORE the
+        # sidecars materialize (sidecars ship while the first big
+        # chunks decode); when one can, the stream waits for the
+        # sidecar key sets so the membership filter and min/max
+        # row-group pruning stay effective.
+        filter_col: Dict[int, str] = {}
+        for pj in self.path_joins:
+            if exact_max > 0 and pj.can_filter and len(pj.big_keys) == 1:
                 col = _resolve_to_scan_col(
                     pj.big_keys[0],
                     pj.join.left if pj.big_on_left else pj.join.right,
                     scan)
+                if col is not None:
+                    filter_col[id(pj)] = col
+
+        scan_cols = scan.columns
+        filters: List[_HostKeyFilter] = []
+        counters = {"rows_in": 0, "rows_kept": 0}
+
+        def make_prepare(read_cols):
+            drop_extra = (scan_cols is not None
+                          and len(read_cols or ()) != len(scan_cols))
+
+            def prepare(tbl):
+                counters["rows_in"] += tbl.num_rows
+                if filters:
+                    with stats.timed("filter"):
+                        keep = np.ones(tbl.num_rows, dtype=bool)
+                        for kf in filters:
+                            vals = _decode_key_np(tbl.column(kf.col))
+                            if vals is None:
+                                continue
+                            keep &= kf.member(vals)
+                        if not keep.all():
+                            tbl = tbl.filter(keep)
+                        if drop_extra:
+                            tbl = tbl.select(list(scan_cols))
+                if tbl.num_rows == 0:
+                    return None
+                counters["rows_kept"] += tbl.num_rows
+                with stats.timed("decode"):
+                    sch, arrs, vlds = arrow_to_numpy(tbl)
+                with stats.timed("transfer"):
+                    batch = from_numpy(
+                        sch, arrs, vlds,
+                        capacity=_chunk_capacity(tbl.num_rows, fixed_cap),
+                        narrow_transfer=True).block_until_ready()
+                return L.Relation(batch)
+
+            return prepare
+
+        def rel_nbytes(rel):
+            return rel.batch.device_nbytes()
+
+        pipe = None
+        try:
+            if depth >= 1 and not filter_col:
+                pipe = ChunkPipeline(
+                    scan.source.iter_batches(scan_cols,
+                                             tuple(scan.filters),
+                                             chunk_rows),
+                    make_prepare(scan_cols), depth=depth,
+                    byte_budget=prefetch_budget, stats=stats,
+                    nbytes_of=rel_nbytes)
+
+            # 1. materialize each sidecar ONCE; they stay
+            # device-resident
+            sidecar_rel: Dict[int, L.LogicalPlan] = {}
+            side_log = _progress_logger("sidecar")
+            for si, pj in enumerate(self.path_joins):
+                side_log(si, 0)
+                with stats.timed("sidecar"):
+                    batch = run_fn(pj.sidecar)
+                sidecar_rel[id(pj.sidecar)] = L.Relation(batch)
+                col = filter_col.get(id(pj))
                 if col is None:
                     continue
                 skey = E.strip_alias(pj.sidecar_keys[0])
                 try:
-                    kb = run_fn(L.Project(
-                        (E.Alias(skey, "__semi_k"),),
-                        L.Relation(batch)))
+                    with stats.timed("sidecar"):
+                        kb = run_fn(L.Project(
+                            (E.Alias(skey, "__semi_k"),),
+                            L.Relation(batch)))
                     vals = _int_key_values(kb, "__semi_k")
                 except Exception:
                     vals = None
                 if vals is not None:
                     filters.append(_HostKeyFilter(col, vals, exact_max))
-        skeleton = _splice(agg.child, sidecar_rel) \
-            if sidecar_rel else agg.child
+            skeleton = _splice(agg.child, sidecar_rel) \
+                if sidecar_rel else agg.child
 
-        # 2. push key ranges into the scan, stream + filter chunks
-        scan_filters = tuple(scan.filters)
-        scan_cols = scan.columns
-        for kf in filters:
-            try:
-                scan_filters = scan_filters \
-                    + tuple(kf.range_conjuncts(scan.schema))
-            except Exception:
-                pass
-        if filters and scan_cols is not None:
-            # membership columns must be in the streamed projection
-            need = [kf.col for kf in filters if kf.col not in scan_cols]
-            read_cols = tuple(scan_cols) + tuple(dict.fromkeys(need))
-        else:
-            read_cols = scan_cols
-
-        keys = tuple(E.Col(n) for n in spec.key_names)
-        merge_outs = tuple(E.Alias(E.Col(n), n)
-                           for n in spec.key_names) + tuple(spec.merges)
-
-        def merge_plan(state_rel, partial):
-            if state_rel is None:
-                return L.Aggregate(keys, merge_outs, partial)
-            aligned = L.Project(
-                tuple(E.Col(n) for n in state_rel.schema.names), partial)
-            return L.Aggregate(keys, merge_outs,
-                               L.Union(state_rel, aligned))
-
-        state = _MergeState(merge_plan, run_fn)
-        rows_in = rows_kept = 0
-        progress = _progress_logger("chunked_agg")
-        for tbl in scan.source.iter_batches(read_cols, scan_filters,
-                                            chunk_rows):
-            progress(state.chunks, rows_in)
-            rows_in += tbl.num_rows
-            if filters:
-                keep = np.ones(tbl.num_rows, dtype=bool)
+            if pipe is None:
+                # 2. push key ranges into the scan, then stream +
+                # filter chunks
+                scan_filters = tuple(scan.filters)
                 for kf in filters:
-                    col = tbl.column(kf.col)
-                    vals = _decode_key_np(col)
-                    if vals is None:
-                        continue
-                    keep &= kf.member(vals)
-                if not keep.all():
-                    tbl = tbl.filter(keep)
-                if scan_cols is not None \
-                        and len(read_cols) != len(scan_cols):
-                    tbl = tbl.select(list(scan_cols))
-            if tbl.num_rows == 0:
-                continue
-            rows_kept += tbl.num_rows
-            chunk_plan = _splice(
-                skeleton,
-                {id(scan): L.Relation(from_arrow(
-                    tbl,
-                    capacity=_chunk_capacity(tbl.num_rows, fixed_cap),
-                    narrow_transfer=True))})
-            partial = L.Aggregate(tuple(spec.groupings_exec),
-                                  key_aliases + tuple(spec.partials),
-                                  chunk_plan)
-            state.feed(partial)
+                    try:
+                        scan_filters = scan_filters \
+                            + tuple(kf.range_conjuncts(scan.schema))
+                    except Exception:
+                        pass
+                if filters and scan_cols is not None:
+                    # membership columns must be in the streamed
+                    # projection
+                    need = [kf.col for kf in filters
+                            if kf.col not in scan_cols]
+                    read_cols = tuple(scan_cols) \
+                        + tuple(dict.fromkeys(need))
+                else:
+                    read_cols = scan_cols
+                pipe = ChunkPipeline(
+                    scan.source.iter_batches(read_cols, scan_filters,
+                                             chunk_rows),
+                    make_prepare(read_cols), depth=depth,
+                    byte_budget=prefetch_budget, stats=stats,
+                    nbytes_of=rel_nbytes)
+
+            keys = tuple(E.Col(n) for n in spec.key_names)
+            merge_outs = tuple(E.Alias(E.Col(n), n)
+                               for n in spec.key_names) \
+                + tuple(spec.merges)
+
+            def merge_plan(state_rel, partial):
+                if state_rel is None:
+                    return L.Aggregate(keys, merge_outs, partial)
+                aligned = L.Project(
+                    tuple(E.Col(n) for n in state_rel.schema.names),
+                    partial)
+                return L.Aggregate(keys, merge_outs,
+                                   L.Union(state_rel, aligned))
+
+            state = _MergeState(merge_plan, run_fn)
+            progress = _progress_logger("chunked_agg")
+            for rel in pipe:
+                with stats.timed("compute"):
+                    chunk_plan = _splice(skeleton, {id(scan): rel})
+                    partial = L.Aggregate(
+                        tuple(spec.groupings_exec),
+                        key_aliases + tuple(spec.partials), chunk_plan)
+                    state.feed(partial)
+                progress(state.chunks, counters["rows_in"], stats)
+        finally:
+            if pipe is not None:
+                pipe.close()
         metrics.record(
             "chunked_agg", chunks=state.chunks,
             sidecars=len(sidecar_rel), key_filters=len(filters),
-            rows_in=rows_in, rows_kept=rows_kept,
+            rows_in=counters["rows_in"],
+            rows_kept=counters["rows_kept"],
             groups=0 if state.batch is None
-            else state.batch.num_valid_rows())
+            else state.batch.num_valid_rows(),
+            pipeline_depth=depth, **stats.finish())
 
         if state.batch is None:
             # empty stream: run the aggregate over an EMPTY spliced
@@ -571,10 +647,15 @@ class _GraceHashAgg:
 
     def execute(self, conf, run_fn):
         from spark_tpu import metrics
-        from spark_tpu.columnar.arrow import from_arrow
+        from spark_tpu.columnar.arrow import arrow_to_numpy
+        from spark_tpu.columnar.batch import from_numpy
+        from spark_tpu.physical.pipeline import ChunkPipeline
 
         budget = conf.get(MAX_DEVICE_BATCH_BYTES)
         chunk_rows = conf.get(CHUNK_ROWS)
+        depth = conf.get(CF.PIPELINE_DEPTH)
+        prefetch_budget = conf.get(CF.PREFETCH_BYTES_MAX)
+        stats = metrics.PipelineStats()
         nparts = int(min(conf.get(GRACE_PARTITIONS_MAX),
                          max(2, -(-4 * self.est_total // max(budget, 1)))))
 
@@ -593,8 +674,21 @@ class _GraceHashAgg:
                     buckets[p].append(tbl.filter(h == p))
             return buckets
 
-        buckets_a = partition(self.scan_a, self.key_a)
-        buckets_b = partition(self.scan_b, self.key_b)
+        with stats.timed("decode"):
+            if depth >= 1:
+                # both sides' partition passes are pure host work
+                # (parquet decode + hash into bucket lists) over
+                # disjoint state — run them concurrently
+                import concurrent.futures as _cf
+
+                with _cf.ThreadPoolExecutor(
+                        2, thread_name_prefix="grace-partition") as pool:
+                    fa = pool.submit(partition, self.scan_a, self.key_a)
+                    fb = pool.submit(partition, self.scan_b, self.key_b)
+                    buckets_a, buckets_b = fa.result(), fb.result()
+            else:
+                buckets_a = partition(self.scan_a, self.key_a)
+                buckets_b = partition(self.scan_b, self.key_b)
 
         spec = AggSpec(self.agg.groupings, self.agg.aggregates)
         key_aliases = tuple(E.Alias(g, n) for g, n
@@ -631,26 +725,50 @@ class _GraceHashAgg:
         cap_b = round_capacity(max(
             [sum(t.num_rows for t in b or ()) for b in buckets_b] or [1]))
         outer = self.join.how in ("left", "right", "full")
+        parts = []
         for p in range(nparts):
             if not buckets_a[p] and not buckets_b[p]:
                 continue
             if not outer and (not buckets_a[p] or not buckets_b[p]):
                 if self.join.how != "left_anti" or not buckets_a[p]:
                     continue
-            ta = concat(buckets_a[p], self.scan_a)
-            tb = concat(buckets_b[p], self.scan_b)
-            buckets_a[p] = buckets_b[p] = None  # free host RAM as we go
-            chunk_plan = _splice(self.agg.child, {
-                id(self.scan_a): L.Relation(from_arrow(
-                    ta, capacity=cap_a, narrow_transfer=True)),
-                id(self.scan_b): L.Relation(from_arrow(
-                    tb, capacity=cap_b, narrow_transfer=True))})
-            partial = L.Aggregate(tuple(spec.groupings_exec),
-                                  key_aliases + tuple(spec.partials),
-                                  chunk_plan)
-            state.feed(partial)
+            parts.append(p)
+
+        def prepare(p):
+            with stats.timed("decode"):
+                ta = concat(buckets_a[p], self.scan_a)
+                tb = concat(buckets_b[p], self.scan_b)
+                buckets_a[p] = buckets_b[p] = None  # free host RAM
+                sa, aa, va = arrow_to_numpy(ta)
+                sb, ab, vb = arrow_to_numpy(tb)
+            with stats.timed("transfer"):
+                ba = from_numpy(sa, aa, va, capacity=cap_a,
+                                narrow_transfer=True).block_until_ready()
+                bb = from_numpy(sb, ab, vb, capacity=cap_b,
+                                narrow_transfer=True).block_until_ready()
+            return {id(self.scan_a): L.Relation(ba),
+                    id(self.scan_b): L.Relation(bb)}
+
+        pipe = ChunkPipeline(
+            parts, prepare, depth=depth, byte_budget=prefetch_budget,
+            stats=stats,
+            nbytes_of=lambda m: sum(r.batch.device_nbytes()
+                                    for r in m.values()))
+        progress = _progress_logger("grace_hash_agg")
+        try:
+            for mapping in pipe:
+                with stats.timed("compute"):
+                    chunk_plan = _splice(self.agg.child, mapping)
+                    partial = L.Aggregate(
+                        tuple(spec.groupings_exec),
+                        key_aliases + tuple(spec.partials), chunk_plan)
+                    state.feed(partial)
+                progress(state.chunks, 0, stats)
+        finally:
+            pipe.close()
         metrics.record("grace_hash_agg", partitions=nparts,
-                       chunks=state.chunks)
+                       chunks=state.chunks, pipeline_depth=depth,
+                       **stats.finish())
 
         if state.batch is None:
             final0: L.LogicalPlan = L.Aggregate(
@@ -682,9 +800,14 @@ class _ChunkedTopK:
 
     def execute(self, conf, run_fn):
         from spark_tpu import metrics
-        from spark_tpu.columnar.arrow import from_arrow
+        from spark_tpu.columnar.arrow import arrow_to_numpy
+        from spark_tpu.columnar.batch import from_numpy, round_capacity
+        from spark_tpu.physical.pipeline import ChunkPipeline
 
         chunk_rows = conf.get(CHUNK_ROWS)
+        depth = conf.get(CF.PIPELINE_DEPTH)
+        prefetch_budget = conf.get(CF.PREFETCH_BYTES_MAX)
+        stats = metrics.PipelineStats()
         k = self.limit.n + self.limit.offset
 
         def merge_plan(state_rel, chunk_plan):
@@ -695,22 +818,39 @@ class _ChunkedTopK:
                           chunk_plan))
             return L.Limit(k, L.Sort(self.sort.orders, child))
 
-        from spark_tpu.columnar.batch import round_capacity
-
         fixed_cap = round_capacity(chunk_rows)
         state = _MergeState(merge_plan, run_fn)
-        for tbl in self.big.source.iter_batches(
-                self.big.columns, self.big.filters, chunk_rows):
+
+        def prepare(tbl):
             if tbl.num_rows == 0:
-                continue
-            chunk_plan = _splice(
-                self.chain_root,
-                {id(self.big): L.Relation(from_arrow(
-                    tbl,
+                return None
+            with stats.timed("decode"):
+                sch, arrs, vlds = arrow_to_numpy(tbl)
+            with stats.timed("transfer"):
+                batch = from_numpy(
+                    sch, arrs, vlds,
                     capacity=_chunk_capacity(tbl.num_rows, fixed_cap),
-                    narrow_transfer=True))})
-            state.feed(chunk_plan)
-        metrics.record("chunked_topk", chunks=state.chunks, k=k)
+                    narrow_transfer=True).block_until_ready()
+            return L.Relation(batch)
+
+        pipe = ChunkPipeline(
+            self.big.source.iter_batches(self.big.columns,
+                                         self.big.filters, chunk_rows),
+            prepare, depth=depth, byte_budget=prefetch_budget,
+            stats=stats,
+            nbytes_of=lambda rel: rel.batch.device_nbytes())
+        progress = _progress_logger("chunked_topk")
+        try:
+            for rel in pipe:
+                with stats.timed("compute"):
+                    chunk_plan = _splice(self.chain_root,
+                                         {id(self.big): rel})
+                    state.feed(chunk_plan)
+                progress(state.chunks, 0, stats)
+        finally:
+            pipe.close()
+        metrics.record("chunked_topk", chunks=state.chunks, k=k,
+                       pipeline_depth=depth, **stats.finish())
 
         if state.batch is None:
             base: L.LogicalPlan = L.Limit(

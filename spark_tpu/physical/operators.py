@@ -1240,12 +1240,18 @@ def _pair_names(left_names, right_names) -> List[str]:
 #: (output capacity = probe capacity) and fuse into one XLA program with
 #: zero host syncs — the difference between ~6 and ~2 tunnel round trips
 #: per TPC-H query.
-#: Global gate for adaptive-stats RECORDING (reads stay enabled). The
-#: chunked out-of-HBM executor runs hundreds of single-shot plans whose
-#: leaf arrays never recur; recording them costs a blocking host sync
-#: per plan and floods the LRU caches with dead-weakref entries that
-#: evict live queries' stats.
-_STATS_RECORDING = [True]
+#: Gate for adaptive-stats RECORDING (reads stay enabled). The chunked
+#: out-of-HBM executor runs hundreds of single-shot plans whose leaf
+#: arrays never recur; recording them costs a blocking host sync per
+#: plan and floods the LRU caches with dead-weakref entries that evict
+#: live queries' stats. A ContextVar, not a module global: the chunk
+#: pipeline (physical/pipeline.py) runs producer threads concurrently
+#: with the consumer's merge loop, and the consumer's disabled window
+#: must neither leak into nor be clobbered by another thread.
+import contextvars as _contextvars
+
+_STATS_RECORDING = _contextvars.ContextVar("stats_recording",
+                                           default=True)
 
 
 class stats_recording_disabled:
@@ -1253,16 +1259,15 @@ class stats_recording_disabled:
     syncs that feed it) for single-shot plan executions."""
 
     def __enter__(self):
-        self._prev = _STATS_RECORDING[0]
-        _STATS_RECORDING[0] = False
+        self._token = _STATS_RECORDING.set(False)
 
     def __exit__(self, *exc):
-        _STATS_RECORDING[0] = self._prev
+        _STATS_RECORDING.reset(self._token)
         return False
 
 
 def stats_recording() -> bool:
-    return _STATS_RECORDING[0]
+    return _STATS_RECORDING.get()
 
 
 class _AdaptiveStatsCache:
@@ -1300,7 +1305,7 @@ class _AdaptiveStatsCache:
     def put(self, key_and_pins, value) -> None:
         import weakref
 
-        if not _STATS_RECORDING[0]:
+        if not _STATS_RECORDING.get():
             return
         key, pins = key_and_pins
         try:

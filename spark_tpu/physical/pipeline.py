@@ -1,0 +1,206 @@
+"""Asynchronous chunk pipeline for out-of-HBM execution.
+
+The serial chunk loop (decode chunk -> filter host-side -> ship ->
+compute -> repeat) leaves the TPU idle during every decode/transfer and
+the host idle during every device step — fatal on a ~34 MB/s tunneled
+host->device link. This module is the producer/consumer overlap Spark's
+shuffle fetch path gets from ShuffleBlockFetcherIterator's in-flight
+request window (core/.../storage/ShuffleBlockFetcherIterator.scala:78):
+a background producer thread pulls the next chunks off the parquet
+stream, applies the host-side semi/Bloom key filters, narrows them, and
+initiates the host->device transfer, while the caller thread merges the
+previous chunks' partials on device.
+
+Determinism: ONE producer thread feeding a FIFO queue, consumed in
+source order — the device merge order is identical to the serial loop
+at every depth, so float results are byte-identical (the acceptance
+contract of tests/test_out_of_core.py's depth-sweep tests).
+
+Bounds: ``spark.tpu.pipelineDepth`` caps the number of prepared chunks
+in flight; ``spark.tpu.prefetchBytesMax`` caps their bytes (the
+producer stalls before decoding the next chunk once in-flight bytes
+reach the budget — at least one chunk is always admitted so a budget
+smaller than a chunk degrades to serial instead of deadlocking).
+
+``depth == 0`` runs the classic serial loop on the caller thread with
+the same staging/timers, so the two paths share one code shape.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from spark_tpu.metrics import PipelineStats
+
+_SENTINEL = object()
+
+
+class _Err:
+    """Producer-side exception carrier (re-raised on the consumer)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class ChunkPipeline:
+    """Bounded producer/consumer pipeline over an iterator of work items.
+
+    ``source`` yields raw work items (arrow tables, partition ids);
+    pulling the next item is timed as the *decode* stage. ``prepare``
+    turns one item into a consumable result (timing its own filter/
+    transfer stages against ``stats``) or returns None to skip the item
+    (empty / fully filtered chunk). ``nbytes_of(prepared)`` feeds the
+    in-flight byte budget.
+
+    With ``depth >= 1`` the producer thread starts at construction, so
+    chunk decode can overlap work the caller does before it starts
+    consuming (e.g. sidecar materialization). Iterate the pipeline to
+    consume results in source order.
+    """
+
+    def __init__(self, source: Iterable[Any],
+                 prepare: Callable[[Any], Optional[Any]],
+                 *, depth: int, byte_budget: int,
+                 stats: PipelineStats,
+                 nbytes_of: Optional[Callable[[Any], int]] = None):
+        self._source = iter(source)
+        self._prepare = prepare
+        self._depth = max(0, int(depth))
+        self._budget = max(1, int(byte_budget))
+        self._stats = stats
+        self._nbytes = nbytes_of or (lambda prepared: 0)
+        self._thread: Optional[threading.Thread] = None
+        if self._depth >= 1:
+            self._queue: queue.Queue = queue.Queue(maxsize=self._depth)
+            self._cond = threading.Condition()
+            self._inflight_bytes = 0
+            self._inflight_chunks = 0
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._produce, daemon=True, name="chunk-pipeline")
+            self._thread.start()
+
+    # ---- serial path (depth == 0) -----------------------------------------
+
+    def _iter_serial(self) -> Iterator[Any]:
+        st = self._stats
+        while True:
+            with st.timed("decode"):
+                item = next(self._source, _SENTINEL)
+            if item is _SENTINEL:
+                return
+            prepared = self._prepare(item)
+            if prepared is None:
+                continue
+            st.note_inflight(self._nbytes(prepared), 1)
+            yield prepared
+
+    # ---- threaded path -----------------------------------------------------
+
+    def _produce(self) -> None:
+        st = self._stats
+        try:
+            while True:
+                # byte-budget gate BEFORE decoding the next chunk: once
+                # in-flight bytes reach the budget, prefetch pauses
+                # (but one chunk is always admitted)
+                t0 = time.perf_counter()
+                with self._cond:
+                    while (not self._stop
+                           and self._inflight_chunks > 0
+                           and self._inflight_bytes >= self._budget):
+                        self._cond.wait(0.05)
+                    if self._stop:
+                        return
+                waited = (time.perf_counter() - t0) * 1e3
+                if waited > 0.05:
+                    st.add("stall_producer", waited)
+                with st.timed("decode"):
+                    item = next(self._source, _SENTINEL)
+                if item is _SENTINEL:
+                    break
+                prepared = self._prepare(item)
+                if prepared is None:
+                    continue
+                size = self._nbytes(prepared)
+                with self._cond:
+                    self._inflight_bytes += size
+                    self._inflight_chunks += 1
+                    st.note_inflight(self._inflight_bytes,
+                                     self._inflight_chunks)
+                self._put((prepared, size))
+            self._put(_SENTINEL)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._put_nowait_or_drop(_Err(e))
+
+    def _put(self, obj: Any) -> None:
+        """queue.put that stays responsive to consumer abandonment."""
+        t0 = time.perf_counter()
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+            try:
+                self._queue.put(obj, timeout=0.1)
+                waited = (time.perf_counter() - t0) * 1e3
+                if waited > 0.1:
+                    self._stats.add("stall_producer", waited)
+                return
+            except queue.Full:
+                continue
+
+    def _put_nowait_or_drop(self, obj: Any) -> None:
+        try:
+            self._queue.put_nowait(obj)
+        except queue.Full:
+            # consumer abandoned with a full queue; it will observe
+            # _stop and never block on get again
+            pass
+
+    def _iter_threaded(self) -> Iterator[Any]:
+        st = self._stats
+        try:
+            while True:
+                t0 = time.perf_counter()
+                got = self._queue.get()
+                waited = (time.perf_counter() - t0) * 1e3
+                if waited > 0.05:
+                    st.add("stall_consumer", waited)
+                if got is _SENTINEL:
+                    return
+                if isinstance(got, _Err):
+                    raise got.exc
+                prepared, size = got
+                try:
+                    yield prepared
+                finally:
+                    with self._cond:
+                        self._inflight_bytes -= size
+                        self._inflight_chunks -= 1
+                        self._cond.notify_all()
+        finally:
+            self.close()
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._depth == 0:
+            return self._iter_serial()
+        return self._iter_threaded()
+
+    def close(self) -> None:
+        """Stop the producer (idempotent; called automatically when the
+        consuming iterator finishes or is abandoned)."""
+        if self._thread is None:
+            return
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        # drain so a producer blocked on put() can observe _stop
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -724,7 +724,11 @@ class _ExprParser:
             self.expect(")")
             import re as _re
 
-            return E.RegexpReplace(e, _re.escape(find), repl)
+            # REPLACE is literal: escape pattern syntax in the needle
+            # and backslashes in the replacement (special in re.sub
+            # templates — \1 would act as a backreference)
+            return E.RegexpReplace(e, _re.escape(find),
+                                   repl.replace("\\", "\\\\"))
         if name == "TRANSLATE":
             e = self.parse()
             self.expect(",")

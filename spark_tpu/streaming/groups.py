@@ -218,7 +218,16 @@ class GroupStateQuery:
                 f, preserve_index=False))
         self._register_sink()
 
-    # -- state layout: key tuple + pickled state value ------------------------
+    # -- state layout: key tuple + pickled, versioned state payload -----------
+    #
+    # The payload is a tagged dict ({_STATE_TAG: <format version>, ...}),
+    # NOT a bare (value, deadline) tuple: shape-sniffing breaks the
+    # moment a user's state value is itself a 2-tuple, and leaves no
+    # room for new fields. Legacy layouts (untagged 2-tuple from the
+    # timeout era, bare value before that) are still read.
+
+    _STATE_TAG = "__group_state__"
+    _STATE_VERSION = 1
 
     def _load_states(self, version: int) -> dict:
         tbl = self._store.get(version)
@@ -229,9 +238,18 @@ class GroupStateQuery:
         val_bin = tbl.column("__state").to_pylist()
         for kb, vb in zip(key_bin, val_bin):
             payload = pickle.loads(vb)
-            if isinstance(payload, tuple) and len(payload) == 2:
-                value, deadline = payload
-            else:  # pre-timeout checkpoint layout
+            if isinstance(payload, dict) and self._STATE_TAG in payload:
+                ver = payload[self._STATE_TAG]
+                if ver > self._STATE_VERSION:
+                    raise ValueError(
+                        f"group-state checkpoint format v{ver} is newer "
+                        f"than this engine supports "
+                        f"(v{self._STATE_VERSION})")
+                value = payload["value"]
+                deadline = payload.get("deadline_ms")
+            elif isinstance(payload, tuple) and len(payload) == 2:
+                value, deadline = payload  # legacy (value, deadline)
+            else:  # pre-timeout checkpoint layout: bare value
                 value, deadline = payload, None
             out[pickle.loads(kb)] = GroupState(value, True,
                                                deadline_ms=deadline)
@@ -239,7 +257,9 @@ class GroupStateQuery:
 
     def _commit_states(self, version: int, states: dict) -> None:
         keys = [pickle.dumps(k) for k in states]
-        vals = [pickle.dumps((s.getOption(), s._deadline_ms))
+        vals = [pickle.dumps({self._STATE_TAG: self._STATE_VERSION,
+                              "value": s.getOption(),
+                              "deadline_ms": s._deadline_ms})
                 for s in states.values()]
         self._store.commit(version, pa.table({
             "__key": pa.array(keys, pa.binary()),

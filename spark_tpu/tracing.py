@@ -17,6 +17,8 @@ event stream from metrics.py. This module glues the two:
   inside the device trace (TraceAnnotation).
 - ``query_profile()``: the last query's per-operator wall-time rollup
   from the event stream — the text form of the SQL-tab DAG view.
+- ``pipeline_profile()``: the out-of-HBM chunk pipeline's per-tier
+  stage/overlap rollup (decode/filter/transfer vs device compute).
 - ``planning_tracker``: phase timing for parse/optimize/plan (the
   QueryPlanningTracker analogue).
 """
@@ -81,6 +83,60 @@ def format_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     for op, rec in rows:
         lines.append(f"{op:<{width}}  {rec['count']:>5}  "
                      f"{rec['total_ms']:>8.2f}  {rec['max_ms']:>6.2f}")
+    return "\n".join(lines)
+
+
+_PIPELINE_EVENTS = ("chunked_agg", "chunked_topk", "grace_hash_agg")
+
+
+def pipeline_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up the last query's out-of-HBM pipeline events into a
+    per-tier overlap summary: {tier: {chunks, decode_ms, filter_ms,
+    transfer_ms, compute_ms, wall_ms, overlap_ms, overlap_ratio,
+    stall_producer_ms, stall_consumer_ms, pipeline_depth}}. The
+    producer-stage sums (decode+filter+transfer) against wall_ms show
+    how much of the host work the pipeline hid behind device compute."""
+    evs = events if events is not None else metrics.last_query()
+    out: Dict[str, dict] = {}
+    for e in evs:
+        kind = e.get("kind")
+        if kind not in _PIPELINE_EVENTS:
+            continue
+        rec = out.setdefault(kind, defaultdict(float))
+        rec["events"] = int(rec["events"]) + 1
+        for k in ("chunks", "decode_ms", "filter_ms", "transfer_ms",
+                  "compute_ms", "sidecar_ms", "wall_ms", "overlap_ms",
+                  "stall_producer_ms", "stall_consumer_ms"):
+            if k in e:
+                rec[k] = round(rec[k] + float(e[k]), 3)
+        if "pipeline_depth" in e:
+            rec["pipeline_depth"] = int(e["pipeline_depth"])
+    for rec in out.values():
+        wall = rec.get("wall_ms", 0.0)
+        rec["overlap_ratio"] = (
+            round(rec.get("overlap_ms", 0.0) / wall, 4) if wall else 0.0)
+    return {k: dict(v) for k, v in out.items()}
+
+
+def format_pipeline_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else pipeline_profile()
+    if not p:
+        return "(no out-of-HBM pipeline events recorded)"
+    lines = []
+    for tier, rec in sorted(p.items()):
+        lines.append(
+            f"{tier}: chunks={int(rec.get('chunks', 0))} "
+            f"depth={rec.get('pipeline_depth', '?')} "
+            f"wall={rec.get('wall_ms', 0.0):.1f}ms "
+            f"overlap={rec.get('overlap_ms', 0.0):.1f}ms "
+            f"({100 * rec.get('overlap_ratio', 0.0):.0f}%)")
+        lines.append(
+            f"  decode={rec.get('decode_ms', 0.0):.1f} "
+            f"filter={rec.get('filter_ms', 0.0):.1f} "
+            f"transfer={rec.get('transfer_ms', 0.0):.1f} "
+            f"compute={rec.get('compute_ms', 0.0):.1f} "
+            f"stall_prod={rec.get('stall_producer_ms', 0.0):.1f} "
+            f"stall_cons={rec.get('stall_consumer_ms', 0.0):.1f}")
     return "\n".join(lines)
 
 

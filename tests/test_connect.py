@@ -79,3 +79,44 @@ def test_typed_plan_protocol(spark):
             assert "no_such_fn" in str(e)
     finally:
         srv.stop()
+
+
+def test_using_right_join_keys_from_right(spark):
+    """RIGHT USING join: unmatched right rows carry NULL in the left
+    region, so the merged key column must be projected from the RIGHT
+    side (still under the un-suffixed output name)."""
+    from spark_tpu.connect.server import Client, ConnectServer
+
+    spark.createDataFrame(
+        [{"k": 1, "v": 10}, {"k": 2, "v": 20}]
+    ).createOrReplaceTempView("cpr_l")
+    spark.createDataFrame(
+        [{"k": 2, "w": 200}, {"k": 3, "w": 300}]
+    ).createOrReplaceTempView("cpr_r")
+    srv = ConnectServer(spark, port=0).start()
+    try:
+        c = Client(srv.url)
+        j = (c.table("cpr_l").join(c.table("cpr_r"), on="k", how="right")
+             .sort("k").toArrow())
+        assert j.column_names == ["k", "v", "w"]
+        assert j.to_pylist() == [
+            {"k": 2, "v": 20, "w": 200},
+            {"k": 3, "v": None, "w": 300}]  # k=3, not NULL
+    finally:
+        srv.stop()
+
+
+def test_fn_dispatch_is_allowlisted():
+    """Module attributes that happen to be callable are not protocol
+    surface: only the explicit scalar-function registry dispatches."""
+    from spark_tpu.connect import proto
+
+    # F.expr / F.col exist on the module but are session-side builders
+    for name in ("expr", "col", "lit", "window", "udf"):
+        with pytest.raises(ValueError, match="unknown function"):
+            proto.decode_expr({"e": "fn", "name": name,
+                               "args": [{"e": "lit", "value": "x"}]})
+    # registry functions still decode
+    e = proto.decode_expr({"e": "fn", "name": "upper",
+                           "args": [{"e": "col", "name": "s"}]})
+    assert e is not None

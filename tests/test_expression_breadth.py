@@ -72,6 +72,22 @@ def test_round_half_up(spark):
     assert got == [-3.0, 3.0, 4.0]  # HALF_UP like Spark, not half-even
 
 
+def test_replace_literal_backslash(edf):
+    """REPLACE is literal on both sides: a replacement containing
+    backslashes must not act as an re.sub template ('\\1' used to be a
+    backreference into the escaped — group-free — pattern)."""
+    spark, _ = edf
+    rows = spark.sql(
+        "select replace(s, 'l', '\\1') as r from exprs").collect()
+    vals = sorted(r.r for r in rows)
+    assert "WORLD" in vals           # no 'l': untouched
+    assert "c\\1aude v5" in vals     # literal backslash-one inserted
+
+    df = spark.createDataFrame([{"s": "a_b_c"}])
+    out = df.select(F.replace(F.col("s"), "_", "\\").alias("r")).collect()
+    assert out[0].r == "a\\b\\c"     # lone backslash: was 'bad escape'
+
+
 def test_regexp(spark):
     rows = spark.sql(
         "select regexp_extract(s, '([a-z]+) v([0-9]+)', 2) as ver, "

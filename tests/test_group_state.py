@@ -86,6 +86,88 @@ def test_checkpoint_restart_restores_state(spark, tmp_path):
     assert ("a", 3) in rows  # 2 from restored state + 1 new
 
 
+def test_tuple_valued_state_roundtrips(spark, tmp_path):
+    """A user state value that is itself a 2-tuple must survive a
+    checkpoint restart intact — the old layout shape-sniffed
+    ``(value, deadline)`` and would misread it."""
+    def pair_counter(key, pdf, state):
+        cnt, tot = state.get() if state.exists else (0, 0)
+        cnt, tot = cnt + len(pdf), tot + int(pdf["v"].sum())
+        state.update((cnt, tot))
+        return pd.DataFrame({"k": [key[0]], "cnt": [cnt], "tot": [tot]})
+
+    ckpt = str(tmp_path / "gs_pair")
+    src = MemoryStream(pa.schema([("k", pa.string()),
+                                  ("v", pa.int64())]))
+    df = spark.readStream.load(src).groupBy("k").applyInPandasWithState(
+        pair_counter, "k string, cnt long, tot long")
+    q = df.writeStream.outputMode("update").queryName("gsp") \
+        .option("checkpointLocation", ckpt).start()
+    src.add_data([{"k": "a", "v": 10}, {"k": "a", "v": 20}])
+    q.processAllAvailable()
+    q.stop()
+
+    df2 = spark.readStream.load(src).groupBy("k").applyInPandasWithState(
+        pair_counter, "k string, cnt long, tot long")
+    q2 = df2.writeStream.outputMode("update").queryName("gspb") \
+        .option("checkpointLocation", ckpt).start()
+    src.add_data([{"k": "a", "v": 5}])
+    q2.processAllAvailable()
+    rows = {(r["k"], r["cnt"], r["tot"])
+            for r in spark.table("gspb").collect()}
+    assert ("a", 3, 35) in rows
+
+
+def test_legacy_checkpoint_layouts_load(spark):
+    """Versioned payloads coexist with both legacy layouts: the
+    untagged (value, deadline) tuple and the pre-timeout bare value."""
+    import pickle
+
+    from spark_tpu.streaming.groups import GroupStateQuery
+
+    class _Q:  # borrow only the loader
+        _load_states = GroupStateQuery._load_states
+        _STATE_TAG = GroupStateQuery._STATE_TAG
+        _STATE_VERSION = GroupStateQuery._STATE_VERSION
+
+        def __init__(self, tbl):
+            self._tbl = tbl
+
+        class _Store:
+            def __init__(self, tbl):
+                self._tbl = tbl
+
+            def get(self, version):
+                return self._tbl
+
+        @property
+        def _store(self):
+            return self._Store(self._tbl)
+
+    tbl = pa.table({
+        "__key": pa.array([pickle.dumps(("a",)), pickle.dumps(("b",)),
+                           pickle.dumps(("c",))], pa.binary()),
+        "__state": pa.array([
+            pickle.dumps({"__group_state__": 1, "value": 7,
+                          "deadline_ms": 123}),     # current
+            pickle.dumps((5, None)),                # legacy tuple
+            pickle.dumps(42),                       # pre-timeout bare
+        ], pa.binary())})
+    states = _Q(tbl)._load_states(0)
+    assert states[("a",)].get() == 7
+    assert states[("a",)]._deadline_ms == 123
+    assert states[("b",)].get() == 5
+    assert states[("c",)].get() == 42
+
+    # a NEWER format version fails loudly instead of misreading
+    tbl2 = pa.table({
+        "__key": pa.array([pickle.dumps(("z",))], pa.binary()),
+        "__state": pa.array([pickle.dumps(
+            {"__group_state__": 99, "value": 1})], pa.binary())})
+    with pytest.raises(ValueError, match="newer"):
+        _Q(tbl2)._load_states(0)
+
+
 def test_plan_below_group_runs_on_engine(spark):
     src = MemoryStream(pa.schema([("k", pa.string()),
                                   ("v", pa.int64())]))

@@ -274,6 +274,154 @@ def test_chunked_topk(spark, join_parquet):
     assert got == want
 
 
+# -- async chunk pipeline --------------------------------------------------
+
+
+def _with_oc_conf(spark, depth, **extra):
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", extra.pop(
+        "maxDeviceBatchBytes", 100_000))
+    spark.conf.set("spark.tpu.chunkRows", extra.pop("chunkRows", 16_384))
+    spark.conf.set("spark.tpu.pipelineDepth", depth)
+    for k, v in extra.items():
+        spark.conf.set(k, v)
+
+
+def _unset_oc_conf(spark, *extra):
+    for k in ("spark.tpu.maxDeviceBatchBytes", "spark.tpu.chunkRows",
+              "spark.tpu.pipelineDepth") + extra:
+        spark.conf.unset(k)
+
+
+def test_pipeline_depth_sweep_chunked_agg(spark, join_parquet):
+    """Pipelined execution is byte-identical to serial: one producer
+    thread feeds a FIFO queue, so the device merge order (and thus
+    float accumulation order) never changes with depth."""
+    from spark_tpu import metrics
+
+    sql = ("select k % 7 as g, sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk group by k % 7 "
+           "order by g")
+    # integer sums: the resident path is comparable EXACTLY too
+    want = [(r.g, r.s, r.n) for r in spark.sql(sql).collect()]
+    by_depth = {}
+    for depth in (0, 1, 2):
+        _with_oc_conf(spark, depth)
+        try:
+            metrics.reset()
+            by_depth[depth] = [(r.g, r.s, r.n)
+                               for r in spark.sql(sql).collect()]
+            evs = _chunk_events("chunked_agg")
+            assert evs and evs[-1]["chunks"] >= 2
+            assert evs[-1]["pipeline_depth"] == depth
+        finally:
+            _unset_oc_conf(spark)
+    assert by_depth[0] == want  # chunked == resident (integer sums)
+    # EXACT equality across depths — not approx
+    assert by_depth[1] == by_depth[0]
+    assert by_depth[2] == by_depth[0]
+
+
+def test_pipeline_depth_sweep_grace_hash(spark, join_parquet):
+    """Grace-hash joins pipeline the per-bucket passes; bucket order is
+    unchanged, so results are exactly identical at every depth."""
+    from spark_tpu import metrics
+
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]
+    by_depth = {}
+    for depth in (0, 1, 2):
+        _with_oc_conf(spark, depth, maxDeviceBatchBytes=1024,
+                      chunkRows=32_768)
+        try:
+            metrics.reset()
+            by_depth[depth] = [(r.s, r.n)
+                               for r in spark.sql(sql).collect()]
+            evs = _chunk_events("grace_hash_agg")
+            assert evs and evs[-1]["partitions"] >= 2
+            assert evs[-1]["pipeline_depth"] == depth
+        finally:
+            _unset_oc_conf(spark)
+    assert by_depth[0] == want  # chunked == resident (integer sums)
+    assert by_depth[1] == by_depth[0]
+    assert by_depth[2] == by_depth[0]
+
+
+def test_pipeline_depth_sweep_topk(spark, join_parquet):
+    from spark_tpu import metrics
+
+    sql = ("select k, v from oc_fact where v >= 10 "
+           "order by v desc, k asc limit 9")
+    by_depth = {}
+    for depth in (0, 2):
+        _with_oc_conf(spark, depth, chunkRows=32_768)
+        try:
+            metrics.reset()
+            by_depth[depth] = [(r.k, r.v)
+                               for r in spark.sql(sql).collect()]
+            assert _chunk_events("chunked_topk")
+        finally:
+            _unset_oc_conf(spark)
+    assert by_depth[2] == by_depth[0]
+
+
+def test_pipeline_byte_budget_bounds_inflight(spark, big_parquet):
+    """prefetchBytesMax caps prepared-but-unconsumed chunks: a 1-byte
+    budget admits exactly one chunk at a time (and must not deadlock)."""
+    from spark_tpu import metrics
+
+    path, _ = big_parquet
+    df = spark.read.parquet(path)
+    agg = df.groupBy("k").agg(F.sum("v").alias("s"),
+                              F.count("v").alias("n"))
+    want = {r.k: (r.s, r.n) for r in agg.collect()}
+    _with_oc_conf(spark, 2, maxDeviceBatchBytes=1024,
+                  **{"spark.tpu.prefetchBytesMax": 1})
+    try:
+        metrics.reset()
+        got = {r.k: (r.s, r.n) for r in agg.collect()}
+        evs = _chunk_events("chunked_agg")
+        assert evs and evs[-1]["chunks"] >= 6
+        assert evs[-1]["max_inflight_chunks"] == 1
+    finally:
+        _unset_oc_conf(spark, "spark.tpu.prefetchBytesMax")
+    # resident vs chunked differ in float accumulation ORDER (that's
+    # inherent to chunking, not the pipeline): approx for sums,
+    # exact for counts
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k][1] == want[k][1]
+        assert got[k][0] == pytest.approx(want[k][0], rel=1e-9)
+
+
+def test_pipeline_overlap_recorded(spark, big_parquet):
+    """With depth >= 1 on a multi-chunk aggregation, the producer's
+    decode/transfer genuinely overlaps device compute — the concurrency
+    clock (wall time with both a producer and a consumer stage active)
+    must be non-zero."""
+    from spark_tpu import metrics
+
+    path, _ = big_parquet
+    agg = (spark.read.parquet(path).groupBy("k")
+           .agg(F.sum("v").alias("s"), F.avg("v").alias("a"),
+                F.max("w").alias("hi")))
+    _with_oc_conf(spark, 2, maxDeviceBatchBytes=1024, chunkRows=8_192)
+    try:
+        metrics.reset()
+        agg.collect()
+        evs = _chunk_events("chunked_agg")
+        assert evs and evs[-1]["chunks"] >= 10
+        ev = evs[-1]
+        assert ev["pipeline_depth"] == 2
+        assert ev["overlap_ms"] > 0.0
+        assert ev["overlap_ratio"] > 0.0
+        assert ev["wall_ms"] >= ev["overlap_ms"]
+        for stage in ("decode_ms", "transfer_ms", "compute_ms"):
+            assert ev[stage] >= 0.0
+    finally:
+        _unset_oc_conf(spark)
+
+
 def test_skewed_join_split_non_broadcastable(spark):
     """Build side over SKEW_MAX_BROADCAST_BYTES: the join SPLITS around
     the hot key (hot probe rows stay row-sliced against a broadcast of

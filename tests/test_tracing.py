@@ -37,3 +37,27 @@ def test_jax_profiler_trace_writes_files(tmp_path, spark):
     for root, _, files in os.walk(d):
         found.extend(files)
     assert found, "jax profiler produced no trace files"
+
+
+def test_pipeline_profile_rolls_up_chunk_events():
+    evs = [
+        {"kind": "chunked_agg", "chunks": 4, "decode_ms": 10.0,
+         "transfer_ms": 5.0, "compute_ms": 8.0, "wall_ms": 20.0,
+         "overlap_ms": 5.0, "pipeline_depth": 2},
+        {"kind": "chunked_agg", "chunks": 2, "decode_ms": 4.0,
+         "transfer_ms": 1.0, "compute_ms": 2.0, "wall_ms": 10.0,
+         "overlap_ms": 1.0, "pipeline_depth": 2},
+        {"kind": "stage", "op": "HashAggregate", "ms": 3.0},
+    ]
+    prof = tracing.pipeline_profile(evs)
+    assert set(prof) == {"chunked_agg"}
+    rec = prof["chunked_agg"]
+    assert rec["chunks"] == 6
+    assert rec["decode_ms"] == 14.0
+    assert rec["overlap_ms"] == 6.0
+    assert rec["overlap_ratio"] == 0.2  # 6 / 30
+    text = tracing.format_pipeline_profile(prof)
+    assert "chunked_agg" in text and "overlap" in text
+
+    assert tracing.pipeline_profile([]) == {}
+    assert "no out-of-HBM" in tracing.format_pipeline_profile({})
