@@ -118,8 +118,14 @@ class Batch:
                 cd.data.block_until_ready()
                 if cd.validity is not None:
                     cd.validity.block_until_ready()
-        except (AttributeError, RuntimeError):
-            pass  # non-jax arrays (tests) or deleted buffers
+        except AttributeError:
+            pass  # non-jax arrays (tests) have no block_until_ready
+        except RuntimeError as e:
+            # a deleted buffer is benign (chunk already consumed); any
+            # other RuntimeError is a real transfer/allocation failure
+            # and must surface here, on the producer thread
+            if "deleted" not in str(e).lower():
+                raise
         return self
 
     # ---- host materialization -------------------------------------------

@@ -137,16 +137,21 @@ def decode_plan(obj: Dict[str, Any], session) -> L.LogicalPlan:
         # positionally onto each side's schema. For a RIGHT join the
         # key values must come from the RIGHT side — unmatched right
         # rows carry NULL in the left region — surfaced under the
-        # left's (un-suffixed) output name.
-        if names and how in ("inner", "left", "right"):
+        # left's (un-suffixed) output name. For a FULL join either
+        # region may hold the NULL, so the key is
+        # coalesce(left_key, right_key).
+        if names and how in ("inner", "left", "right", "full"):
             ln = len(left.schema.names)
             lout = list(joined.schema.names)[:ln]
             rout = list(joined.schema.names)[ln:]
             rmap = dict(zip(right.schema.names, rout))
             exprs = []
             for o, src in zip(lout, left.schema.names):
-                if how == "right" and src in names:
+                if src in names and how == "right":
                     exprs.append(E.Alias(E.Col(rmap[src]), o))
+                elif src in names and how == "full":
+                    exprs.append(E.Alias(
+                        E.Coalesce((E.Col(o), E.Col(rmap[src]))), o))
                 else:
                     exprs.append(E.Col(o))
             exprs.extend(E.Col(o) for o, src in zip(rout, right.schema.names)

@@ -455,9 +455,16 @@ class MeshExecutor:
                 schema_box["schema"] = batch.schema
                 return batch.data
 
-            smapped = jax.shard_map(local_fn, mesh=self.mesh,
+            if hasattr(jax, "shard_map"):
+                smapped = jax.shard_map(local_fn, mesh=self.mesh,
+                                        in_specs=_SPEC, out_specs=_SPEC,
+                                        check_vma=False)
+            else:  # jax < 0.6: experimental API, check_rep not check_vma
+                from jax.experimental.shard_map import shard_map
+
+                smapped = shard_map(local_fn, mesh=self.mesh,
                                     in_specs=_SPEC, out_specs=_SPEC,
-                                    check_vma=False)
+                                    check_rep=False)
             entry = (jax.jit(smapped), schema_box)
             _DIST_STAGE_CACHE[key] = entry
         jitted, schema_box = entry

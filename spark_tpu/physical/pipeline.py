@@ -134,7 +134,11 @@ class ChunkPipeline:
                 self._put((prepared, size))
             self._put(_SENTINEL)
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
-            self._put_nowait_or_drop(_Err(e))
+            # a full queue is the steady state of an active pipeline, so
+            # the error must be relayed with the stop-aware blocking put:
+            # it delivers to an active consumer and bails out via _stop
+            # if the consumer abandoned the iterator
+            self._put(_Err(e))
 
     def _put(self, obj: Any) -> None:
         """queue.put that stays responsive to consumer abandonment."""
@@ -151,14 +155,6 @@ class ChunkPipeline:
                 return
             except queue.Full:
                 continue
-
-    def _put_nowait_or_drop(self, obj: Any) -> None:
-        try:
-            self._queue.put_nowait(obj)
-        except queue.Full:
-            # consumer abandoned with a full queue; it will observe
-            # _stop and never block on get again
-            pass
 
     def _iter_threaded(self) -> Iterator[Any]:
         st = self._stats

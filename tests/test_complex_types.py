@@ -179,11 +179,14 @@ def test_struct_null_rows_propagate(spark):
     assert [r["s.b"] for r in rows] == [2.0, None, 4.0]
 
 
-def test_make_array_nullable_inputs_rejected(spark):
+def test_make_array_nullable_input_nulls_whole_row(spark):
+    # null ELEMENTS are not representable in the padded layout: a null
+    # input nulls the WHOLE array row (documented ArrayType deviation)
     tbl = pa.table({"x": pa.array([1, None], pa.int64())})
     df = spark.createDataFrame(tbl)
-    with pytest.raises(NotImplementedError, match="null elements"):
-        df.select(F.array(F.col("x"), F.lit(1)).alias("a")).collect()
+    rows = df.select(F.array(F.col("x"), F.lit(1)).alias("a")).collect()
+    assert rows[0]["a"] == [1, 1]
+    assert rows[1]["a"] is None
 
 
 def test_array_contains_float_needle_no_truncate(arr_df):

@@ -106,6 +106,32 @@ def test_using_right_join_keys_from_right(spark):
         srv.stop()
 
 
+def test_using_full_join_coalesced_keys(spark):
+    """FULL USING join: either region may hold the NULL key, so the
+    merged key column is coalesce(left.k, right.k) — the key appears
+    once and is never NULL for a row that exists on either side."""
+    from spark_tpu.connect.server import Client, ConnectServer
+
+    spark.createDataFrame(
+        [{"k": 1, "v": 10}, {"k": 2, "v": 20}]
+    ).createOrReplaceTempView("cpf_l")
+    spark.createDataFrame(
+        [{"k": 2, "w": 200}, {"k": 3, "w": 300}]
+    ).createOrReplaceTempView("cpf_r")
+    srv = ConnectServer(spark, port=0).start()
+    try:
+        c = Client(srv.url)
+        j = (c.table("cpf_l").join(c.table("cpf_r"), on="k", how="full")
+             .sort("k").toArrow())
+        assert j.column_names == ["k", "v", "w"]
+        assert j.to_pylist() == [
+            {"k": 1, "v": 10, "w": None},
+            {"k": 2, "v": 20, "w": 200},
+            {"k": 3, "v": None, "w": 300}]  # k=3 from the right side
+    finally:
+        srv.stop()
+
+
 def test_fn_dispatch_is_allowlisted():
     """Module attributes that happen to be callable are not protocol
     surface: only the explicit scalar-function registry dispatches."""

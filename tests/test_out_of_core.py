@@ -394,6 +394,41 @@ def test_pipeline_byte_budget_bounds_inflight(spark, big_parquet):
         assert got[k][0] == pytest.approx(want[k][0], rel=1e-9)
 
 
+def test_pipeline_producer_error_relayed_under_backpressure():
+    """A producer error while the queue is FULL (the steady state of an
+    active pipeline) must still be relayed to the consumer — dropping
+    it would leave the consumer blocked on get() forever and lose the
+    original exception."""
+    import threading
+    import time
+
+    from spark_tpu.metrics import PipelineStats
+    from spark_tpu.physical.pipeline import ChunkPipeline
+
+    def source():
+        yield from (1, 2, 3)
+        raise ValueError("decode failed")
+
+    pipe = ChunkPipeline(source(), lambda x: x, depth=2,
+                         byte_budget=1 << 30, stats=PipelineStats())
+    got, err = [], []
+
+    def consume():
+        try:
+            for item in pipe:
+                got.append(item)
+                time.sleep(0.2)  # slow consumer -> queue stays full
+        except ValueError as e:
+            err.append(e)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=15)
+    assert not t.is_alive(), "consumer hung: producer error was dropped"
+    assert got == [1, 2, 3]
+    assert err and "decode failed" in str(err[0])
+
+
 def test_pipeline_overlap_recorded(spark, big_parquet):
     """With depth >= 1 on a multi-chunk aggregation, the producer's
     decode/transfer genuinely overlaps device compute — the concurrency
