@@ -92,6 +92,23 @@ def _snapshot(payload: dict) -> None:
 # documented Spark CPU local[*] SF1 estimates (see module docstring)
 BASELINE_MS = {1: 900.0, 3: 700.0, 5: 1100.0}
 
+# robustness events worth surfacing in the result JSON: a benchmark run
+# that silently retried stages or degraded to the chunked tier is not
+# measuring what the headline number claims
+_ROBUSTNESS_KINDS = ("stage_retry", "chunk_retry", "fault_injected",
+                     "fault_recovered", "degraded_to_chunked")
+
+
+def _robustness_counters() -> dict:
+    from spark_tpu import metrics
+
+    counts = {k: 0 for k in _ROBUSTNESS_KINDS}
+    for ev in metrics.recent(4096):
+        kind = ev.get("kind")
+        if kind in counts:
+            counts[kind] += 1
+    return counts
+
 
 def _query_bytes(plan, conf) -> int:
     """Bytes of live column data in the plan's scan leaves — the
@@ -169,7 +186,8 @@ def main():
                   file=sys.stderr, flush=True)
             results[qnum] = {"error": f"{type(e).__name__}: {e}"}
         _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()}})
+                   "queries": {str(k): v for k, v in results.items()},
+                   "robustness": _robustness_counters()})
 
 
     full = {}
@@ -203,7 +221,8 @@ def main():
                 full[qnum] = f"error: {type(e).__name__}: {e}"
             _snapshot({"partial": True, "sf": SF,
                        "queries": {str(k): v for k, v in results.items()},
-                       "all22_ms": {str(k): v for k, v in full.items()}})
+                       "all22_ms": {str(k): v for k, v in full.items()},
+                       "robustness": _robustness_counters()})
 
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
@@ -225,6 +244,7 @@ def main():
         "gen_s": round(gen_s, 1),
         "parquet_io_s": round(io_s, 1),
         "baseline": "Spark CPU local[*] SF1 estimate (see bench.py docstring)",
+        "robustness": _robustness_counters(),
         "queries": {str(k): v for k, v in results.items()},
         **({"all22_ms": {str(k): v for k, v in full.items()}}
            if full else {}),

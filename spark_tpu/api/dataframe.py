@@ -296,20 +296,19 @@ class DataFrame:
         def run_full(plan):
             """Engine run with the out-of-HBM chunking decision applied
             — also used to materialize cached plans so a cached big
-            aggregate chunks instead of OOMing."""
+            aggregate chunks instead of OOMing. Whole-batch OOM degrades
+            through the chunked tier at a halved device budget
+            (recovery.run_plan_with_oom_degradation) instead of
+            failing."""
             if self._session is None:
                 return run(plan)
-            from spark_tpu.physical.chunked import (execute_chunked,
-                                                    find_chunkable)
             from spark_tpu.plan.optimizer import optimize as opt
+            from spark_tpu.recovery import run_plan_with_oom_degradation
 
             lp = opt(plan)
-            found = find_chunkable(lp, self._session.conf)
-            if found is not None:
-                return execute_chunked(
-                    found, self._session.conf,
-                    lambda p: run(p, optimize=False))
-            return run(lp, optimize=False)
+            return run_plan_with_oom_degradation(
+                lp, self._session.conf,
+                lambda p: run(p, optimize=False))
 
         plan = self._plan
         if self._session is not None:

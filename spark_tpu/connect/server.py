@@ -7,15 +7,23 @@ from __future__ import annotations
 import io
 import json
 import threading
+import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import pyarrow as pa
 
+from spark_tpu import faults
+
 
 class ConnectServer:
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat=None):
         self.session = session
+        #: optional recovery.HeartbeatMonitor surfaced via GET /health;
+        #: falls back to one attached to the session
+        self.heartbeat = heartbeat if heartbeat is not None \
+            else getattr(session, "heartbeat_monitor", None)
         #: the engine session is not thread-safe (LRU caches, catalog,
         #: conf) — queries execute serially, handlers stay concurrent
         #: for health/metadata (reference: thriftserver runs statements
@@ -28,11 +36,16 @@ class ConnectServer:
                 pass
 
             def _send(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client gave up (e.g. its deadline passed while
+                    # the request hung) — nothing left to tell it
+                    pass
 
             def do_GET(self):
                 if self.path == "/tables":
@@ -40,7 +53,12 @@ class ConnectServer:
                         outer.session.catalog.listTables()).encode()
                     self._send(200, body, "application/json")
                 elif self.path == "/health":
-                    self._send(200, b"ok", "text/plain")
+                    hb = outer.heartbeat
+                    body = json.dumps(
+                        {"status": "ok",
+                         "heartbeat": hb.status() if hb is not None
+                         else None}).encode()
+                    self._send(200, body, "application/json")
                 else:
                     self._send(404, b"not found", "text/plain")
 
@@ -50,6 +68,7 @@ class ConnectServer:
                     return
                 n = int(self.headers.get("Content-Length", "0"))
                 try:
+                    faults.inject("connect.request", outer.session.conf)
                     req = json.loads(self.rfile.read(n))
                     with outer._exec_lock:
                         if self.path == "/sql":
@@ -74,7 +93,8 @@ class ConnectServer:
                 except Exception as e:  # error -> JSON with message
                     body = json.dumps(
                         {"error": type(e).__name__,
-                         "message": str(e)}).encode()
+                         "message": str(e),
+                         "traceback": traceback.format_exc()}).encode()
                     self._send(400, body, "application/json")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -96,56 +116,77 @@ class ConnectServer:
         return f"http://{self.host}:{self.port}"
 
 
-def serve(session, host: str = "127.0.0.1",
-          port: int = 15002) -> ConnectServer:
+def serve(session, host: str = "127.0.0.1", port: int = 15002,
+          heartbeat=None) -> ConnectServer:
     """Start the server (default port mirrors Spark Connect's 15002)."""
-    return ConnectServer(session, host, port).start()
+    return ConnectServer(session, host, port,
+                         heartbeat=heartbeat).start()
 
 
 class Client:
     """Minimal client: sql() -> pyarrow.Table (reference client surface:
     pyspark.sql.connect.session.SparkSession.sql)."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, timeout: float = 60.0):
         self.url = url.rstrip("/")
+        #: per-request deadline — urllib otherwise blocks forever on a
+        #: hung server
+        self.timeout = float(timeout)
 
-    def sql(self, query: str) -> pa.Table:
+    def _post(self, path: str, payload: dict) -> pa.Table:
+        import socket
+        import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
-            self.url + "/sql",
-            data=json.dumps({"query": query}).encode(),
+            self.url + path,
+            data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req) as resp:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             detail = json.loads(e.read())
+            msg = f"{detail.get('error')}: {detail.get('message')}"
+            tb = detail.get("traceback")
+            if tb:
+                msg += f"\n--- server traceback ---\n{tb}"
+            raise RuntimeError(msg) from None
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None),
+                          (socket.timeout, TimeoutError)):
+                raise RuntimeError(
+                    f"DEADLINE_EXCEEDED: connect request to "
+                    f"{self.url + path} timed out after "
+                    f"{self.timeout:g}s") from e
+            raise
+        except (socket.timeout, TimeoutError) as e:
             raise RuntimeError(
-                f"{detail.get('error')}: {detail.get('message')}") from None
+                f"DEADLINE_EXCEEDED: connect request to "
+                f"{self.url + path} timed out after "
+                f"{self.timeout:g}s") from e
         return pa.ipc.open_stream(io.BytesIO(data)).read_all()
+
+    def sql(self, query: str) -> pa.Table:
+        return self._post("/sql", {"query": query})
 
     def tables(self):
         import urllib.request
 
-        with urllib.request.urlopen(self.url + "/tables") as resp:
+        with urllib.request.urlopen(self.url + "/tables",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def health(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/health",
+                                    timeout=self.timeout) as resp:
             return json.loads(resp.read())
 
     def _execute_plan(self, plan: dict) -> pa.Table:
-        import urllib.request
-
-        req = urllib.request.Request(
-            self.url + "/plan",
-            data=json.dumps({"plan": plan}).encode(),
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req) as resp:
-                data = resp.read()
-        except urllib.error.HTTPError as e:
-            detail = json.loads(e.read())
-            raise RuntimeError(
-                f"{detail.get('error')}: {detail.get('message')}") from None
-        return pa.ipc.open_stream(io.BytesIO(data)).read_all()
+        return self._post("/plan", {"plan": plan})
 
     def table(self, name: str) -> "RemoteDataFrame":
         """Lazy remote DataFrame over the typed plan protocol

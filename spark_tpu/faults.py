@@ -1,0 +1,264 @@
+"""Deterministic, conf-driven fault injection.
+
+The reference proves its recovery paths with chaos-style suites
+(FailureSuite.scala, DAGSchedulerSuite's MockBackend killing executors
+mid-stage); none of our failure paths were testable because there was
+no way to *cause* a failure deterministically at a given seam. This
+module is that switchboard: named injection points wired at the real
+seams of the execution stack, armed per-session through ordinary conf
+keys, raising *typed* faults that the recovery layer classifies the
+same way it classifies the real thing.
+
+Injection points (key = ``spark.tpu.faultInjection.<point>``):
+
+- ``pipeline.decode``    parquet chunk decode in the out-of-HBM chunk
+                         pipeline (physical/pipeline.py producer)
+- ``pipeline.transfer``  host filter + host->device transfer of one
+                         prepared chunk (same producer)
+- ``execute.device``     whole-batch (resident) device execution of a
+                         plan (api/dataframe.py _execute)
+- ``exchange.all_to_all``the all-to-all collective exchange
+                         (parallel/exchange.py, fires at trace time)
+- ``streaming.commit``   micro-batch state/offset commit
+                         (streaming/execution.py)
+- ``connect.request``    the connect server's HTTP request handling
+                         (connect/server.py)
+
+Spec grammar (the conf value):
+
+- ``none``               disarmed (default)
+- ``nth:K[:kind]``       fire exactly once, on the K-th arrival at the
+                         point (1-based) — the deterministic workhorse
+- ``prob:P:SEED[:kind]`` fire each arrival with probability P from a
+                         dedicated ``random.Random(SEED)`` stream —
+                         deterministic across reruns, independent of
+                         any other RNG use
+
+Fault kinds (default ``transient``):
+
+- ``transient``  UNAVAILABLE-style environment failure — retryable
+                 (recovery.is_transient is True)
+- ``oom``        RESOURCE_EXHAUSTED device OOM — NOT retryable; routed
+                 to the degradation ladder (recovery.is_oom is True)
+- ``hang``       sleeps ``spark.tpu.faultInjection.hangSeconds`` then
+                 raises DEADLINE_EXCEEDED — a hang that a deadline
+                 caught, so suites stay bounded while still exercising
+                 the timeout/retry path
+- ``corrupt``    DATA_LOSS — neither transient nor OOM: recovery must
+                 surface it unretried as a clean, typed error
+
+Arming counters live on the conf object, keyed by (point, spec), so
+changing the spec re-arms the point and independent sessions never
+share state. Every fired fault lands in the event log as
+``fault_injected``; recoveries land as ``fault_recovered`` /
+``degraded_to_chunked`` from the layer that absorbed them.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from spark_tpu import conf as CF
+from spark_tpu import metrics
+
+POINTS = (
+    "pipeline.decode",
+    "pipeline.transfer",
+    "execute.device",
+    "exchange.all_to_all",
+    "streaming.commit",
+    "connect.request",
+)
+
+KINDS = ("transient", "oom", "hang", "corrupt")
+
+_ENTRIES = {
+    point: CF.register(
+        f"spark.tpu.faultInjection.{point}", "none",
+        f"Fault injection spec for the '{point}' seam: none | "
+        "nth:K[:kind] | prob:P:SEED[:kind]; kind in "
+        "transient|oom|hang|corrupt (default transient).", str)
+    for point in POINTS
+}
+
+HANG_SECONDS = CF.register(
+    "spark.tpu.faultInjection.hangSeconds", 0.2,
+    "How long an injected 'hang' fault sleeps before surfacing as "
+    "DEADLINE_EXCEEDED (bounded so fault suites never actually hang).",
+    float)
+
+
+class InjectedFault(Exception):
+    """Base class for injected faults; carries the point and kind so
+    tests and the event log can tell injected failures from real ones."""
+
+    kind = "transient"
+
+    def __init__(self, point: str, message: str):
+        super().__init__(message)
+        self.point = point
+
+
+class InjectedTransientError(InjectedFault):
+    """UNAVAILABLE-shaped environment failure (retryable)."""
+
+    kind = "transient"
+
+
+class InjectedDeadlineError(InjectedFault):
+    """DEADLINE_EXCEEDED surfaced after an injected hang (retryable)."""
+
+    kind = "hang"
+
+
+class InjectedOOMError(InjectedFault):
+    """RESOURCE_EXHAUSTED device OOM (degradation ladder, not retry)."""
+
+    kind = "oom"
+
+
+class InjectedCorruptionError(InjectedFault):
+    """DATA_LOSS — unrecoverable by design; must surface unretried."""
+
+    kind = "corrupt"
+
+
+@dataclass(frozen=True)
+class _Spec:
+    mode: str  # "nth" | "prob"
+    kind: str
+    k: int = 0
+    p: float = 0.0
+    seed: int = 0
+
+
+def parse_spec(spec: str) -> Optional[_Spec]:
+    """Parse a spec string; None when disarmed. Raises ValueError on a
+    malformed spec — a typo'd injection silently doing nothing would be
+    the exact observability hole this module exists to close."""
+    s = str(spec or "").strip()
+    if not s or s == "none":
+        return None
+    parts = s.split(":")
+    try:
+        if parts[0] == "nth" and len(parts) in (2, 3):
+            kind = parts[2] if len(parts) == 3 else "transient"
+            out = _Spec("nth", kind, k=int(parts[1]))
+        elif parts[0] == "prob" and len(parts) in (3, 4):
+            kind = parts[3] if len(parts) == 4 else "transient"
+            out = _Spec("prob", kind, p=float(parts[1]),
+                        seed=int(parts[2]))
+        else:
+            raise ValueError(s)
+    except (ValueError, IndexError):
+        raise ValueError(
+            f"malformed fault-injection spec {spec!r}: expected "
+            "none | nth:K[:kind] | prob:P:SEED[:kind]") from None
+    if out.kind not in KINDS:
+        raise ValueError(
+            f"unknown fault kind {out.kind!r} in spec {spec!r}: "
+            f"expected one of {KINDS}")
+    return out
+
+
+class _PointState:
+    __slots__ = ("calls", "fired", "rng")
+
+    def __init__(self, spec: _Spec):
+        self.calls = 0
+        self.fired = 0
+        self.rng = random.Random(spec.seed) if spec.mode == "prob" \
+            else None
+
+
+_LOCK = threading.Lock()
+
+
+def _resolve_conf(conf):
+    if conf is not None:
+        return conf
+    # seams inside traced/collective code (exchange) have no conf in
+    # scope: fall back to the active session's
+    try:
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession._active
+        return None if sess is None else sess.conf
+    except Exception:
+        return None
+
+
+def _state(conf, point: str, spec_str: str, spec: _Spec) -> _PointState:
+    states = conf.__dict__.setdefault("_fault_injection_state", {})
+    key: Tuple[str, str] = (point, spec_str)
+    st = states.get(key)
+    if st is None:
+        st = states[key] = _PointState(spec)
+    return st
+
+
+def reset(conf) -> None:
+    """Drop all arming counters on ``conf`` (tests re-arm cleanly)."""
+    conf.__dict__.pop("_fault_injection_state", None)
+
+
+def fire_count(conf, point: str) -> int:
+    """How many times ``point`` has fired on ``conf`` (any spec)."""
+    states = conf.__dict__.get("_fault_injection_state", {})
+    return sum(st.fired for (p, _), st in states.items() if p == point)
+
+
+def inject(point: str, conf=None) -> None:
+    """Arrival at a named injection point: no-op unless the point is
+    armed on the session conf AND this arrival is selected, in which
+    case the typed fault is recorded and raised (or, for ``hang``,
+    slept then raised as a deadline)."""
+    conf = _resolve_conf(conf)
+    if conf is None:
+        return
+    entry = _ENTRIES.get(point)
+    if entry is None:
+        raise ValueError(f"unknown fault-injection point {point!r}: "
+                         f"expected one of {POINTS}")
+    try:
+        spec_str = conf.get(entry)
+    except KeyError:
+        return
+    spec = parse_spec(spec_str)
+    if spec is None:
+        return
+    with _LOCK:
+        st = _state(conf, point, str(spec_str), spec)
+        st.calls += 1
+        if spec.mode == "nth":
+            fire = st.calls == spec.k and st.fired == 0
+        else:
+            fire = st.rng.random() < spec.p
+        if fire:
+            st.fired += 1
+        calls = st.calls
+    if not fire:
+        return
+    metrics.record("fault_injected", point=point, fault=spec.kind,
+                   call=calls)
+    if spec.kind == "oom":
+        raise InjectedOOMError(
+            point, f"RESOURCE_EXHAUSTED: injected device OOM at "
+                   f"{point} (call {calls})")
+    if spec.kind == "corrupt":
+        raise InjectedCorruptionError(
+            point, f"DATA_LOSS: injected corruption at {point} "
+                   f"(call {calls})")
+    if spec.kind == "hang":
+        delay = float(conf.get(HANG_SECONDS))
+        time.sleep(max(0.0, delay))
+        raise InjectedDeadlineError(
+            point, f"DEADLINE_EXCEEDED: injected hang at {point} "
+                   f"surfaced after {delay:g}s (call {calls})")
+    raise InjectedTransientError(
+        point, f"UNAVAILABLE: injected transient fault at {point} "
+               f"(call {calls})")

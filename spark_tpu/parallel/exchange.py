@@ -105,6 +105,11 @@ def exchange(pipe: Pipe, target: jnp.ndarray) -> Pipe:
     becomes D*cap after the all_to_all. One fused sequence:
     sort-by-destination -> scatter into (D, cap) send buffer ->
     all_to_all over ICI -> flatten."""
+    # fault seam: fires at trace time (a failed trace is never cached,
+    # so a stage retry re-traces and re-arrives here)
+    from spark_tpu import faults
+
+    faults.inject("exchange.all_to_all")
     d = axis_size()
     cap = pipe.capacity
     live = pipe.mask

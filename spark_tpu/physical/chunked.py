@@ -496,7 +496,7 @@ class _ChunkedAgg:
                                              chunk_rows),
                     make_prepare(scan_cols), depth=depth,
                     byte_budget=prefetch_budget, stats=stats,
-                    nbytes_of=rel_nbytes)
+                    nbytes_of=rel_nbytes, conf=conf)
 
             # 1. materialize each sidecar ONCE; they stay
             # device-resident
@@ -548,7 +548,7 @@ class _ChunkedAgg:
                                              chunk_rows),
                     make_prepare(read_cols), depth=depth,
                     byte_budget=prefetch_budget, stats=stats,
-                    nbytes_of=rel_nbytes)
+                    nbytes_of=rel_nbytes, conf=conf)
 
             keys = tuple(E.Col(n) for n in spec.key_names)
             merge_outs = tuple(E.Alias(E.Col(n), n)
@@ -753,7 +753,8 @@ class _GraceHashAgg:
             parts, prepare, depth=depth, byte_budget=prefetch_budget,
             stats=stats,
             nbytes_of=lambda m: sum(r.batch.device_nbytes()
-                                    for r in m.values()))
+                                    for r in m.values()),
+            conf=conf)
         progress = _progress_logger("grace_hash_agg")
         try:
             for mapping in pipe:
@@ -838,7 +839,8 @@ class _ChunkedTopK:
                                          self.big.filters, chunk_rows),
             prepare, depth=depth, byte_budget=prefetch_budget,
             stats=stats,
-            nbytes_of=lambda rel: rel.batch.device_nbytes())
+            nbytes_of=lambda rel: rel.batch.device_nbytes(),
+            conf=conf)
         progress = _progress_logger("chunked_topk")
         try:
             for rel in pipe:

@@ -33,7 +33,16 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from spark_tpu import conf as CF
+from spark_tpu import faults, metrics
 from spark_tpu.metrics import PipelineStats
+
+CHUNK_RETRY_ATTEMPTS = CF.register(
+    "spark.tpu.chunkRetryAttempts", 3,
+    "Bounded attempts for one chunk's decode/prepare/transfer in the "
+    "out-of-HBM pipeline before the failure is relayed to the consumer "
+    "(reference analogue: ShuffleBlockFetcherIterator retrying one "
+    "block fetch instead of failing the stage).", int)
 
 _SENTINEL = object()
 
@@ -65,13 +74,18 @@ class ChunkPipeline:
                  prepare: Callable[[Any], Optional[Any]],
                  *, depth: int, byte_budget: int,
                  stats: PipelineStats,
-                 nbytes_of: Optional[Callable[[Any], int]] = None):
+                 nbytes_of: Optional[Callable[[Any], int]] = None,
+                 conf=None):
         self._source = iter(source)
         self._prepare = prepare
         self._depth = max(0, int(depth))
         self._budget = max(1, int(byte_budget))
         self._stats = stats
         self._nbytes = nbytes_of or (lambda prepared: 0)
+        self._conf = conf
+        self._retry_attempts = max(1, int(
+            conf.get(CHUNK_RETRY_ATTEMPTS) if conf is not None
+            else CHUNK_RETRY_ATTEMPTS.default))
         self._thread: Optional[threading.Thread] = None
         if self._depth >= 1:
             self._queue: queue.Queue = queue.Queue(maxsize=self._depth)
@@ -83,19 +97,65 @@ class ChunkPipeline:
                 target=self._produce, daemon=True, name="chunk-pipeline")
             self._thread.start()
 
+    # ---- shared pull/prepare step with bounded per-chunk retry -------------
+
+    def _next_prepared(self) -> Any:
+        """Pull the next item and prepare it, retrying an individual
+        chunk's decode/prepare/transfer up to chunkRetryAttempts times
+        on transient failures before relaying the error — so one
+        dropped transfer costs one chunk retry, not the whole query.
+        Returns ``(prepared, size)``, ``None`` for a skipped chunk, or
+        ``_SENTINEL`` at end of source.
+
+        Retry safety: a generator that raised is exhausted, so a
+        decode-phase failure is only retryable when it is an injected
+        fault (which fires *before* the source is touched); once the
+        item is in hand, ``prepare`` is pure and always retryable.
+        """
+        from spark_tpu import recovery
+
+        st = self._stats
+        item: Any = _SENTINEL  # sentinel doubles as "not yet pulled"
+        for attempt in range(self._retry_attempts):
+            try:
+                if item is _SENTINEL:
+                    with st.timed("decode"):
+                        faults.inject("pipeline.decode", self._conf)
+                        nxt = next(self._source, _SENTINEL)
+                    if nxt is _SENTINEL:
+                        return _SENTINEL
+                    item = nxt
+                faults.inject("pipeline.transfer", self._conf)
+                prepared = self._prepare(item)
+                if attempt:
+                    metrics.record("fault_recovered", point="pipeline",
+                                   how="chunk_retry", attempts=attempt)
+                if prepared is None:
+                    return None
+                return (prepared, self._nbytes(prepared))
+            except Exception as e:
+                retryable = recovery.is_transient(e) and (
+                    item is not _SENTINEL
+                    or isinstance(e, faults.InjectedFault))
+                if not retryable or attempt + 1 >= self._retry_attempts:
+                    raise
+                metrics.record("chunk_retry", attempt=attempt + 1,
+                               error=repr(e))
+                time.sleep(min(0.05 * 2 ** attempt, 0.5))
+        raise AssertionError("unreachable")  # loop always returns/raises
+
     # ---- serial path (depth == 0) -----------------------------------------
 
     def _iter_serial(self) -> Iterator[Any]:
         st = self._stats
         while True:
-            with st.timed("decode"):
-                item = next(self._source, _SENTINEL)
-            if item is _SENTINEL:
+            got = self._next_prepared()
+            if got is _SENTINEL:
                 return
-            prepared = self._prepare(item)
-            if prepared is None:
+            if got is None:
                 continue
-            st.note_inflight(self._nbytes(prepared), 1)
+            prepared, size = got
+            st.note_inflight(size, 1)
             yield prepared
 
     # ---- threaded path -----------------------------------------------------
@@ -118,14 +178,12 @@ class ChunkPipeline:
                 waited = (time.perf_counter() - t0) * 1e3
                 if waited > 0.05:
                     st.add("stall_producer", waited)
-                with st.timed("decode"):
-                    item = next(self._source, _SENTINEL)
-                if item is _SENTINEL:
+                got = self._next_prepared()
+                if got is _SENTINEL:
                     break
-                prepared = self._prepare(item)
-                if prepared is None:
+                if got is None:
                     continue
-                size = self._nbytes(prepared)
+                prepared, size = got
                 with self._cond:
                     self._inflight_bytes += size
                     self._inflight_chunks += 1

@@ -25,10 +25,11 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Callable, Optional
+import uuid
+from typing import Callable, Iterator, Optional
 
 from spark_tpu import conf as CF
-from spark_tpu import metrics
+from spark_tpu import faults, metrics
 
 STAGE_MAX_ATTEMPTS = CF.register(
     "spark.stage.maxConsecutiveAttempts", 4,
@@ -46,6 +47,19 @@ HEARTBEAT_INTERVAL = CF.register(
     "Seconds between device liveness probes (reference: "
     "HeartbeatReceiver.scala HEARTBEAT_INTERVAL).", float)
 
+OOM_DEGRADE_ENABLED = CF.register(
+    "spark.tpu.oomDegrade.enabled", True,
+    "Whole-batch device OOM replans through the chunked out-of-HBM "
+    "tier with a halved spark.tpu.maxDeviceBatchBytes (halving again "
+    "on repeat OOM) instead of failing — the graceful-degradation "
+    "ladder (reference analogue: TungstenAggregationIterator.scala:82 "
+    "sort-fallback under memory pressure).", bool)
+
+OOM_DEGRADE_FLOOR = CF.register(
+    "spark.tpu.oomDegrade.floorBytes", 1 << 20,
+    "Smallest device-batch budget the OOM degradation ladder will try "
+    "before giving up and surfacing the original OOM.", int)
+
 # Error-message fragments that indicate the *environment* failed (a
 # host dropped out of the collective, the tunnel died, a deadline
 # passed) rather than the query being wrong. Only these are retried —
@@ -62,10 +76,136 @@ _TRANSIENT_MARKERS = (
     "slice has failed",
 )
 
+# exception TYPES that are transient by construction, whatever their
+# message says (a "" ConnectionResetError escaped the substring check)
+_TRANSIENT_TYPES = (
+    ConnectionResetError,
+    ConnectionAbortedError,
+    BrokenPipeError,
+    TimeoutError,
+)
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def _chain(exc: BaseException) -> Iterator[BaseException]:
+    """The exception plus its ``__cause__``/``__context__`` chain (a
+    wrapped DEADLINE_EXCEEDED must still classify as transient)."""
+    seen = set()
+    node: Optional[BaseException] = exc
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        yield node
+        if node.__cause__ is not None:
+            node = node.__cause__
+        elif not node.__suppress_context__:
+            node = node.__context__
+        else:
+            node = None
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Device/host memory exhaustion anywhere in the cause chain. OOM
+    is deliberately NOT transient — retrying the identical plan would
+    exhaust the identical HBM; it routes to the degradation ladder
+    (run_plan_with_oom_degradation) instead."""
+    for e in _chain(exc):
+        if isinstance(e, (faults.InjectedOOMError, MemoryError)):
+            return True
+        # jaxlib's XlaRuntimeError prefixes the grpc status code; match
+        # by type name so jaxlib need not be importable here
+        msg = str(e)
+        if any(m in msg for m in _OOM_MARKERS):
+            return True
+    return False
+
 
 def is_transient(exc: BaseException) -> bool:
-    msg = str(exc)
-    return any(m in msg for m in _TRANSIENT_MARKERS)
+    """True when the failure looks like the *environment* failed and
+    re-running the same plan can succeed. Inspects exception types and
+    the full ``__cause__`` chain, not just ``str(exc)`` — and OOM
+    anywhere in the chain wins: it is never transient."""
+    if is_oom(exc):
+        return False
+    for e in _chain(exc):
+        if isinstance(e, (faults.InjectedTransientError,
+                          faults.InjectedDeadlineError)):
+            return True
+        if isinstance(e, faults.InjectedFault):
+            return False  # injected oom/corrupt: typed non-transient
+        if isinstance(e, _TRANSIENT_TYPES):
+            return True
+        msg = str(e)
+        if type(e).__name__ == "XlaRuntimeError":
+            # status-code prefix, e.g. "ABORTED: collective timed out"
+            status = msg.split(":", 1)[0].strip()
+            if status in ("DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED",
+                          "CANCELLED", "INTERNAL"):
+                return True
+        if any(m in msg for m in _TRANSIENT_MARKERS):
+            return True
+    return False
+
+
+def run_plan_with_oom_degradation(lp, conf, run_fn):
+    """Execute an optimized logical plan with the HBM-pressure
+    degradation ladder: plans whose scans exceed the device budget run
+    chunked as before; a whole-batch (or chunked) execution that dies
+    with OOM is re-planned through ``find_chunkable``/
+    ``execute_chunked`` at a halved ``spark.tpu.maxDeviceBatchBytes``,
+    halving again on repeat down to ``spark.tpu.oomDegrade.floorBytes``
+    — so memory pressure degrades to the out-of-HBM tier instead of
+    failing the query. ``run_fn(plan) -> Batch`` is the raw engine."""
+    from spark_tpu.conf import RuntimeConf
+    from spark_tpu.physical.chunked import (MAX_DEVICE_BATCH_BYTES,
+                                            execute_chunked,
+                                            find_chunkable)
+
+    try:
+        found = find_chunkable(lp, conf)
+        if found is not None:
+            return execute_chunked(found, conf, run_fn)
+        # the whole-batch device execution seam
+        faults.inject("execute.device", conf)
+        return run_fn(lp)
+    except Exception as e:
+        if not (conf.get(OOM_DEGRADE_ENABLED) and is_oom(e)):
+            raise
+        last = e
+
+    budget = int(conf.get(MAX_DEVICE_BATCH_BYTES))
+    floor = max(1, int(conf.get(OOM_DEGRADE_FLOOR)))
+    # shadow conf: the ladder's shrinking budget must not leak into the
+    # session (the next query starts from the configured budget again)
+    shadow = RuntimeConf(dict(conf._overrides))
+    attempted = False
+    while budget // 2 >= floor:
+        budget //= 2
+        shadow.set(MAX_DEVICE_BATCH_BYTES.key, budget)
+        found = find_chunkable(lp, shadow)
+        if found is None:
+            continue  # still under the halved budget: halve again
+        attempted = True
+        metrics.record("degraded_to_chunked", budget=budget,
+                       error=repr(last))
+        try:
+            out = execute_chunked(found, shadow, run_fn)
+        except Exception as e2:
+            if not is_oom(e2):
+                raise
+            last = e2  # chunked tier still OOMs: halve again
+            continue
+        metrics.record("fault_recovered", point="execute.device",
+                       how="degraded_to_chunked", budget=budget)
+        return out
+    if not attempted:
+        # no budget made the plan chunkable (e.g. an in-memory relation
+        # with no file-backed scan): the ladder has nothing to offer —
+        # surface the original typed OOM, not a misleading floor error
+        raise last
+    raise RuntimeError(
+        f"device OOM persisted after degrading the batch budget down "
+        f"to the {floor}-byte floor (last: {last!r})") from last
 
 
 def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
@@ -78,7 +218,11 @@ def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
     last: Optional[BaseException] = None
     for attempt in range(max(1, attempts)):
         try:
-            return fn()
+            out = fn()
+            if attempt:
+                metrics.record("fault_recovered", point=label,
+                               how="stage_retry", attempts=attempt)
+            return out
         except Exception as e:
             if not is_transient(e):
                 raise
@@ -162,6 +306,7 @@ class HeartbeatMonitor:
 
 
 _CKPT_COUNTER = [0]
+_CKPT_LOCK = threading.Lock()
 
 
 def checkpoint_dataframe(df, eager: bool = True):
@@ -176,8 +321,13 @@ def checkpoint_dataframe(df, eager: bool = True):
             "set spark.checkpoint.dir (or SparkContext.setCheckpointDir) "
             "before calling checkpoint(); use localCheckpoint() for the "
             "in-memory variant")
-    _CKPT_COUNTER[0] += 1
-    path = os.path.join(d, f"ckpt-{os.getpid()}-{_CKPT_COUNTER[0]}")
+    with _CKPT_LOCK:
+        _CKPT_COUNTER[0] += 1
+        seq = _CKPT_COUNTER[0]
+    # the uuid component keeps paths unique across sessions in one pid
+    # (the bare counter restarts with the module and collided)
+    path = os.path.join(
+        d, f"ckpt-{os.getpid()}-{seq}-{uuid.uuid4().hex[:8]}")
     df.write.mode("overwrite").parquet(path)
     out = session.read.parquet(path)
     if eager:

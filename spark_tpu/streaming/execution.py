@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
+from spark_tpu import faults, metrics
 from spark_tpu.expr import expressions as E
 from spark_tpu.plan import logical as L
 from spark_tpu.plan.incremental import AggSpec
@@ -142,7 +143,12 @@ class StreamingQuery:
             batch_id = self._batch_id + 1
             logged = self._log.offsets_for(batch_id)
             if logged is not None:
+                # offsets were WAL'd but the batch never committed — a
+                # crash between log_offsets and commit: replay the exact
+                # same range (exactly-once restart)
                 start, end = logged["start"], logged["end"]
+                metrics.record("fault_recovered", point="streaming.commit",
+                               how="wal_replay", batch=batch_id)
             else:
                 prev = self._log.offsets_for(self._batch_id)
                 start = prev["end"] if prev else 0
@@ -176,9 +182,12 @@ class StreamingQuery:
 
         if self._agg is None:
             out = self._to_arrow(_splice(self._plan, rel))
-            self._appended.append(out)
+            faults.inject("streaming.commit", self._session.conf)
             self._store.commit(batch_id, pa.table({}))
             self._log.commit(batch_id)
+            # output is appended only AFTER the commit so a commit
+            # crash + WAL replay cannot duplicate sink rows
+            self._appended.append(out)
             self._batch_id = batch_id
             self._register_sink()
             return
@@ -230,6 +239,7 @@ class StreamingQuery:
         if self.output_mode == "append":
             state_tbl, emitted = self._evict_closed(state_tbl)
 
+        faults.inject("streaming.commit", self._session.conf)
         self._store.commit(batch_id, state_tbl)
         self._log.commit(batch_id, watermark=self._max_event_time)
         self._batch_id = batch_id

@@ -140,6 +140,45 @@ def format_pipeline_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
+_FAULT_EVENTS = ("fault_injected", "fault_recovered",
+                 "degraded_to_chunked", "stage_retry", "chunk_retry")
+
+
+def fault_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up robustness events into {kind: {count, points}} —
+    injected faults, the recoveries that absorbed them, and degradation-
+    ladder activations (the fault-tolerance counterpart of the SQL-tab
+    rollup; reference surfaces these as stage/task failure counts)."""
+    evs = events if events is not None else metrics.recent(4096)
+    out: Dict[str, dict] = {}
+    for e in evs:
+        kind = e.get("kind")
+        if kind not in _FAULT_EVENTS:
+            continue
+        rec = out.setdefault(kind, {"count": 0, "points": {}})
+        rec["count"] += 1
+        point = e.get("point") or e.get("label")
+        if point is not None:
+            rec["points"][point] = rec["points"].get(point, 0) + 1
+    return out
+
+
+def format_fault_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else fault_profile()
+    if not p:
+        return "(no fault events recorded)"
+    lines = []
+    for kind in _FAULT_EVENTS:
+        if kind not in p:
+            continue
+        rec = p[kind]
+        pts = " ".join(f"{pt}={n}" for pt, n in sorted(
+            rec["points"].items()))
+        lines.append(f"{kind}: {rec['count']}" + (f"  ({pts})" if pts
+                                                  else ""))
+    return "\n".join(lines)
+
+
 class PlanningTracker:
     """Phase timing for the planning pipeline (reference:
     catalyst/QueryPlanningTracker.scala). Use as

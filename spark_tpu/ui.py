@@ -78,10 +78,12 @@ class _Handler(BaseHTTPRequestHandler):
                 if ev.get("kind") == "query_start":
                     active = ev.get("description")
                     break
+            hb = getattr(session, "heartbeat_monitor", None)
             self._json({
                 "app": getattr(session, "app_name", "spark-tpu"),
                 "events": len(events),
                 "active_query": active,
+                "heartbeat": hb.status() if hb is not None else None,
             })
         else:
             self._send(404, b"not found", "text/plain")

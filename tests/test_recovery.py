@@ -16,6 +16,64 @@ def test_transient_classification():
     assert not recovery.is_transient(RuntimeError("RESOURCE_EXHAUSTED"))
 
 
+def test_transient_classification_by_type():
+    # transient by exception TYPE even with an unhelpful message
+    assert recovery.is_transient(ConnectionResetError(""))
+    assert recovery.is_transient(BrokenPipeError("x"))
+    assert recovery.is_transient(TimeoutError(""))
+    assert not recovery.is_transient(MemoryError())
+
+
+def test_transient_classification_follows_cause_chain():
+    # a wrapped timeout is still transient…
+    try:
+        try:
+            raise TimeoutError("")
+        except TimeoutError as inner:
+            raise RuntimeError("stage 3 failed") from inner
+    except RuntimeError as wrapped:
+        assert recovery.is_transient(wrapped)
+        assert not recovery.is_oom(wrapped)
+    # …but OOM anywhere in the chain wins: never transient
+    try:
+        try:
+            raise MemoryError()
+        except MemoryError as inner:
+            raise TimeoutError("gave up waiting") from inner
+    except TimeoutError as wrapped:
+        assert recovery.is_oom(wrapped)
+        assert not recovery.is_transient(wrapped)
+
+
+def test_transient_classification_xla_status_prefix():
+    # jaxlib's XlaRuntimeError is matched by type NAME + status prefix
+    # (jaxlib need not be importable by the classifier)
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert recovery.is_transient(XlaRuntimeError("ABORTED: collective"))
+    assert recovery.is_transient(XlaRuntimeError("INTERNAL: dma stall"))
+    assert not recovery.is_transient(
+        XlaRuntimeError("INVALID_ARGUMENT: shape mismatch"))
+    assert not recovery.is_transient(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory on TPU_0"))
+    assert recovery.is_oom(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory on TPU_0"))
+
+
+def test_injected_fault_classification():
+    from spark_tpu import faults
+
+    assert recovery.is_transient(
+        faults.InjectedTransientError("p", "UNAVAILABLE: x"))
+    assert recovery.is_transient(
+        faults.InjectedDeadlineError("p", "DEADLINE_EXCEEDED: x"))
+    assert recovery.is_oom(faults.InjectedOOMError("p", "boom"))
+    assert not recovery.is_transient(faults.InjectedOOMError("p", "boom"))
+    # corrupt: neither transient nor OOM — must surface unretried
+    corrupt = faults.InjectedCorruptionError("p", "DATA_LOSS: x")
+    assert not recovery.is_transient(corrupt)
+    assert not recovery.is_oom(corrupt)
+
+
 def test_stage_retry_recovers_from_transient():
     calls = {"n": 0}
 
@@ -114,3 +172,18 @@ def test_dataframe_checkpoint_requires_dir(spark):
         spark.range(5).checkpoint()
     # localCheckpoint works without a directory
     assert spark.range(5).localCheckpoint().count() == 5
+
+
+def test_checkpoint_paths_unique(spark, tmp_path):
+    """Repeated checkpoints never collide: each lands in its own
+    directory (counter under a lock + a uuid component, so even a
+    fresh process re-using the directory cannot overwrite)."""
+    import os
+
+    spark.conf.set("spark.checkpoint.dir", str(tmp_path))
+    for _ in range(3):
+        spark.range(10).checkpoint()
+    dirs = [d for d in os.listdir(tmp_path) if d.startswith("ckpt-")]
+    assert len(dirs) == 3 and len(set(dirs)) == 3
+    pid = str(os.getpid())
+    assert all(pid in d for d in dirs)
