@@ -146,8 +146,97 @@ def _query_bytes(plan, conf) -> int:
     return total
 
 
+def _percentile(values, q: float) -> float:
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q)) \
+        if values else 0.0
+
+
+def _run_serving(spark, concurrency: int, queries: dict,
+                 rounds: int = 2) -> dict:
+    """Concurrent-clients serving mode: N closed-loop client threads
+    each replay the golden query mix ``rounds`` times through the
+    multi-tenant scheduler (spark_tpu/scheduler/). Every result is
+    checked byte-identical against a serial reference run — a serving
+    number from a scheduler that corrupts results under concurrency
+    would be worse than no number. Reports QPS, p50/p95 end-to-end
+    latency, and p50/p95 admission queue-wait."""
+    import threading
+
+    from spark_tpu.scheduler import QueryScheduler
+
+    # serial reference (also the warm-up: compiles once, off the clock)
+    ref = {q: spark.sql(sql).toArrow() for q, sql in queries.items()}
+
+    sched = QueryScheduler(spark)
+    lock = threading.Lock()
+    latencies, waits, mismatched, errors = [], [], [], []
+
+    def client(idx: int) -> None:
+        for _ in range(rounds):
+            for qnum in sorted(queries):
+                sql = queries[qnum]
+                t0 = time.perf_counter()
+                try:
+                    ticket = sched.submit_query(
+                        lambda sql=sql: spark.sql(sql),
+                        description=f"serving q{qnum} client{idx}")
+                    tbl = ticket.result()
+                except Exception as e:
+                    with lock:
+                        errors.append(f"q{qnum}: {type(e).__name__}: {e}")
+                    continue
+                lat_ms = (time.perf_counter() - t0) * 1e3
+                ok = tbl.equals(ref[qnum])
+                with lock:
+                    latencies.append(lat_ms)
+                    waits.append(ticket.queue_wait_ms())
+                    if not ok:
+                        mismatched.append(qnum)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    sched.stop()
+    total = len(latencies)
+    return {
+        "concurrency": concurrency,
+        "rounds": rounds,
+        "queries_completed": total,
+        "errors": errors[:10],
+        "wall_s": round(wall_s, 2),
+        "qps": round(total / wall_s, 2) if wall_s else 0.0,
+        "p50_ms": round(_percentile(latencies, 50), 1),
+        "p95_ms": round(_percentile(latencies, 95), 1),
+        "queue_wait_p50_ms": round(_percentile(waits, 50), 1),
+        "queue_wait_p95_ms": round(_percentile(waits, 95), 1),
+        "byte_identical_to_serial": not mismatched and not errors,
+        "mismatched_queries": sorted(set(mismatched)),
+    }
+
+
 def main():
+    import argparse
+
     import jax
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--concurrency", type=int,
+        default=int(os.environ.get("BENCH_CONCURRENCY", "0")),
+        help="N>0 adds a serving benchmark: N concurrent client "
+             "threads replay the golden q1/q3/q5 mix through the "
+             "multi-tenant scheduler; QPS + p50/p95 latency and "
+             "queue-wait land under 'serving' in the result JSON")
+    ap.add_argument(
+        "--serving-rounds", type=int,
+        default=int(os.environ.get("BENCH_SERVING_ROUNDS", "2")),
+        help="mix replays per serving client")
+    args = ap.parse_args()
 
     jax.config.update("jax_enable_x64", True)
 
@@ -224,6 +313,19 @@ def main():
                        "all22_ms": {str(k): v for k, v in full.items()},
                        "robustness": _robustness_counters()})
 
+    serving = None
+    if args.concurrency > 0:
+        print(f"[bench] serving: {args.concurrency} concurrent clients",
+              file=sys.stderr, flush=True)
+        try:
+            with _deadline(QUERY_TIMEOUT_S):
+                serving = _run_serving(
+                    spark, args.concurrency,
+                    {q: QUERIES[q] for q in (1, 3, 5)},
+                    rounds=args.serving_rounds)
+        except Exception as e:
+            serving = {"error": f"{type(e).__name__}: {e}"}
+
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
     # headline number stays meaningful (flagged via queries_failed)
@@ -246,6 +348,7 @@ def main():
         "baseline": "Spark CPU local[*] SF1 estimate (see bench.py docstring)",
         "robustness": _robustness_counters(),
         "queries": {str(k): v for k, v in results.items()},
+        **({"serving": serving} if serving is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}
            if full else {}),
     }))

@@ -7,6 +7,7 @@ bootstrap, the 'cluster' is the jax device mesh).
 from __future__ import annotations
 
 import datetime
+import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -117,10 +118,18 @@ class CacheManager:
     execution materializes it to a device Batch, and every later query
     whose tree contains a cached subplan scans the materialized batch
     instead of recomputing. Identity is structural_key() — injective
-    plan structure plus leaf batch/source identity."""
+    plan structure plus leaf batch/source identity.
+
+    Thread-safe: the registry mutates under a lock, and each entry
+    materializes under its own per-entry lock (single-flight — two
+    concurrent queries hitting the same cold cached plan must not
+    both materialize it; the registry lock is NOT held during the
+    materializing run, so unrelated queries proceed)."""
 
     def __init__(self):
+        # entry = [plan, materialized Relation | None, entry lock]
         self._entries: Dict[str, list] = {}
+        self._lock = threading.Lock()
 
     @staticmethod
     def _key(plan: L.LogicalPlan):
@@ -128,26 +137,34 @@ class CacheManager:
         return plan.structural_key()
 
     def add(self, plan: L.LogicalPlan) -> None:
-        self._entries.setdefault(self._key(plan), [plan, None])
+        with self._lock:
+            self._entries.setdefault(
+                self._key(plan), [plan, None, threading.Lock()])
 
     def drop(self, plan: L.LogicalPlan) -> bool:
-        return self._entries.pop(self._key(plan), None) is not None
+        with self._lock:
+            return self._entries.pop(self._key(plan), None) is not None
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
     def apply(self, plan: L.LogicalPlan, run) -> L.LogicalPlan:
         """Substitute cached subtrees, LARGEST first (top-down — the
         reference CacheManager matches outermost plans first so a cached
         derived plan hits even when its own subtree is also cached)."""
-        if not self._entries:
-            return plan
+        with self._lock:
+            if not self._entries:
+                return plan
 
         def go(node: L.LogicalPlan) -> L.LogicalPlan:
-            entry = self._entries.get(self._key(node))
+            with self._lock:
+                entry = self._entries.get(self._key(node))
             if entry is not None:
                 if entry[1] is None:
-                    entry[1] = L.Relation(run(entry[0]))
+                    with entry[2]:  # single-flight materialization
+                        if entry[1] is None:
+                            entry[1] = L.Relation(run(entry[0]))
                 return entry[1]
             children = tuple(go(c) for c in node.children())
             return node.with_children(children) if children else node

@@ -114,6 +114,51 @@ PREFETCH_BYTES_MAX = register(
     "blow host RAM or HBM. At least one chunk is always admitted "
     "(no deadlock on a budget smaller than a single chunk).", int)
 
+# ---- multi-tenant query scheduler (spark_tpu/scheduler/) -------------------
+
+SCHEDULER_MODE = register(
+    "spark.scheduler.mode", "FIFO",
+    "Query scheduling policy across pools: FIFO (global submit order) "
+    "or FAIR (weighted-fair device time across pools; reference: "
+    "TaskSchedulerImpl.scala + Pool.scala spark.scheduler.mode).", str)
+
+SCHEDULER_MAX_CONCURRENCY = register(
+    "spark.tpu.scheduler.maxConcurrency", 4,
+    "Scheduler worker threads: how many queries may run their "
+    "host-side stages (parse, optimize, parquet decode) concurrently. "
+    "Device execution is additionally gated by HBM admission control.",
+    int)
+
+SCHEDULER_QUEUE_DEPTH = register(
+    "spark.tpu.scheduler.queueDepth", 64,
+    "Bound on queued (not yet dequeued) queries across all pools; a "
+    "submit at full queue is rejected immediately (the connect server "
+    "answers 429 with Retry-After) instead of growing an unbounded "
+    "backlog.", int)
+
+SCHEDULER_HBM_BUDGET = register(
+    "spark.tpu.scheduler.hbmBudgetBytes", 2 << 30,
+    "Shared device-bytes budget for HBM admission control: a query is "
+    "admitted to device execution only while the sum of admitted "
+    "queries' estimated footprints fits. A single over-budget query "
+    "still admits alone (charged the full budget) and relies on the "
+    "chunked/OOM-degradation ladder.", int)
+
+SCHEDULER_RETRY_AFTER = register(
+    "spark.tpu.scheduler.retryAfterSeconds", 1.0,
+    "Retry-After hint (seconds) returned with a 429 rejection when "
+    "the scheduler queue is full.", float)
+
+SCHEDULER_DEFAULT_POOL = register(
+    "spark.tpu.scheduler.defaultPool", "default",
+    "Pool a query lands in when the submit carries no pool name "
+    "(reference: spark.scheduler.pool defaulting).", str)
+
+#: free-form per-pool keys (scanned by prefix, not registered):
+#:   spark.tpu.scheduler.pool.<name>.weight    (int, default 1)
+#:   spark.tpu.scheduler.pool.<name>.minShare  (int, default 0)
+SCHEDULER_POOL_PREFIX = "spark.tpu.scheduler.pool."
+
 
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""

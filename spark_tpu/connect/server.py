@@ -18,28 +18,37 @@ from spark_tpu import faults
 
 class ConnectServer:
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat=None):
+                 heartbeat=None, scheduler=None):
+        from spark_tpu.scheduler import QueryScheduler, SchedulerQueueFull
+
         self.session = session
         #: optional recovery.HeartbeatMonitor surfaced via GET /health;
         #: falls back to one attached to the session
         self.heartbeat = heartbeat if heartbeat is not None \
             else getattr(session, "heartbeat_monitor", None)
-        #: the engine session is not thread-safe (LRU caches, catalog,
-        #: conf) — queries execute serially, handlers stay concurrent
-        #: for health/metadata (reference: thriftserver runs statements
-        #: on a session-scoped executor too)
-        self._exec_lock = threading.Lock()
+        #: the multi-tenant query scheduler replaces the old global
+        #: _exec_lock: host-side stages run concurrently on its worker
+        #: pool, device execution is gated by HBM admission control,
+        #: and a full queue answers 429 + Retry-After instead of an
+        #: unbounded backlog (reference: TaskSchedulerImpl + FAIR pools)
+        self.scheduler = scheduler if scheduler is not None \
+            else QueryScheduler(session)
+        # the UI status page reads queue depth / per-pool counts here
+        session.query_scheduler = self.scheduler
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
+            def _send(self, code: int, body: bytes, ctype: str,
+                      headers=None) -> None:
                 try:
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
                     self.end_headers()
                     self.wfile.write(body)
                 except (BrokenPipeError, ConnectionResetError):
@@ -57,12 +66,29 @@ class ConnectServer:
                     body = json.dumps(
                         {"status": "ok",
                          "heartbeat": hb.status() if hb is not None
-                         else None}).encode()
+                         else None,
+                         "scheduler": outer.scheduler.status()}).encode()
+                    self._send(200, body, "application/json")
+                elif self.path.startswith("/queries"):
+                    body = json.dumps(
+                        {"status": outer.scheduler.status(),
+                         "queries": outer.scheduler.describe()}).encode()
                     self._send(200, body, "application/json")
                 else:
                     self._send(404, b"not found", "text/plain")
 
             def do_POST(self):
+                if self.path.startswith("/cancel/"):
+                    try:
+                        qid = int(self.path.rsplit("/", 1)[1])
+                    except ValueError:
+                        self._send(400, b"bad query id", "text/plain")
+                        return
+                    ok = outer.scheduler.cancel(qid)
+                    self._send(200 if ok else 404,
+                               json.dumps({"cancelled": ok}).encode(),
+                               "application/json")
+                    return
                 if self.path not in ("/sql", "/plan"):
                     self._send(404, b"not found", "text/plain")
                     return
@@ -70,26 +96,50 @@ class ConnectServer:
                 try:
                     faults.inject("connect.request", outer.session.conf)
                     req = json.loads(self.rfile.read(n))
-                    with outer._exec_lock:
-                        if self.path == "/sql":
-                            df = outer.session.sql(req["query"])
-                        else:
-                            # typed logical-plan protocol (reference:
-                            # relations.proto decoded by
-                            # SparkConnectPlanner.scala:67)
-                            from spark_tpu.api.dataframe import DataFrame
-                            from spark_tpu.connect.proto import \
-                                decode_plan
 
-                            df = DataFrame(
-                                outer.session,
-                                decode_plan(req["plan"], outer.session))
-                        tbl = df.toArrow()
+                    def build_df():
+                        if self.path == "/sql":
+                            return outer.session.sql(req["query"])
+                        # typed logical-plan protocol (reference:
+                        # relations.proto decoded by
+                        # SparkConnectPlanner.scala:67)
+                        from spark_tpu.api.dataframe import DataFrame
+                        from spark_tpu.connect.proto import decode_plan
+
+                        return DataFrame(
+                            outer.session,
+                            decode_plan(req["plan"], outer.session))
+
+                    pool = req.get("pool") \
+                        or self.headers.get("X-Spark-Pool")
+                    deadline_s = req.get("deadline_s")
+                    ticket = outer.scheduler.submit_query(
+                        build_df, pool=pool,
+                        description=req.get("query",
+                                            f"plan:{self.path}"),
+                        deadline_s=float(deadline_s)
+                        if deadline_s is not None else None)
+                    tbl = ticket.result()
                     sink = io.BytesIO()
                     with pa.ipc.new_stream(sink, tbl.schema) as w:
                         w.write_table(tbl)
-                    self._send(200, sink.getvalue(),
-                               "application/vnd.apache.arrow.stream")
+                    self._send(
+                        200, sink.getvalue(),
+                        "application/vnd.apache.arrow.stream",
+                        headers={
+                            "X-Query-Id": str(ticket.id),
+                            "X-Queue-Wait-Ms":
+                                f"{ticket.queue_wait_ms():.2f}"})
+                except SchedulerQueueFull as e:
+                    # backpressure, not failure: the client should back
+                    # off and retry (Client honors Retry-After)
+                    body = json.dumps(
+                        {"error": "SchedulerQueueFull",
+                         "message": str(e),
+                         "retry_after_s": e.retry_after_s}).encode()
+                    self._send(429, body, "application/json",
+                               headers={"Retry-After":
+                                        f"{e.retry_after_s:g}"})
                 except Exception as e:  # error -> JSON with message
                     body = json.dumps(
                         {"error": type(e).__name__,
@@ -110,6 +160,10 @@ class ConnectServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        self.scheduler.stop()
+        if getattr(self.session, "query_scheduler", None) \
+                is self.scheduler:
+            self.session.query_scheduler = None
 
     @property
     def url(self) -> str:
@@ -123,43 +177,102 @@ def serve(session, host: str = "127.0.0.1", port: int = 15002,
                          heartbeat=heartbeat).start()
 
 
+class _RetryableHTTP(RuntimeError):
+    """A 429 backpressure response; carries the server's Retry-After."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class Client:
     """Minimal client: sql() -> pyarrow.Table (reference client surface:
-    pyspark.sql.connect.session.SparkSession.sql)."""
+    pyspark.sql.connect.session.SparkSession.sql).
 
-    def __init__(self, url: str, timeout: float = 60.0):
+    Transient connection errors (refused/reset — a flapping or
+    restarting server) and 429 backpressure responses are retried with
+    bounded exponential backoff; a 429's Retry-After header is honored
+    as the floor for the next delay. Timeouts and real query errors
+    are NOT retried — a deadline that passed once will pass again, and
+    retrying a genuine bug only quadruples its latency."""
+
+    def __init__(self, url: str, timeout: float = 60.0,
+                 retries: int = 3, backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0):
         self.url = url.rstrip("/")
         #: per-request deadline — urllib otherwise blocks forever on a
         #: hung server
         self.timeout = float(timeout)
+        self.retries = max(0, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
 
-    def _post(self, path: str, payload: dict) -> pa.Table:
+    def _post(self, path: str, payload: dict,
+              pool: Optional[str] = None) -> pa.Table:
+        import time as _time
+
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self._post_once(path, payload, pool)
+            except _RetryableHTTP as e:
+                # 429 backpressure: wait at least the server's
+                # Retry-After hint (capped by max_backoff_s)
+                last = e
+                delay = max(self.backoff_s * (2.0 ** attempt),
+                            e.retry_after_s)
+            except (ConnectionRefusedError, ConnectionResetError,
+                    ConnectionAbortedError, BrokenPipeError) as e:
+                last = e
+                delay = self.backoff_s * (2.0 ** attempt)
+            if attempt >= self.retries:
+                break
+            _time.sleep(min(delay, self.max_backoff_s))
+        raise RuntimeError(
+            f"connect request to {self.url + path} failed after "
+            f"{self.retries + 1} attempts (last: {last!r})") from last
+
+    def _post_once(self, path: str, payload: dict,
+                   pool: Optional[str] = None) -> pa.Table:
         import socket
         import urllib.error
         import urllib.request
 
+        headers = {"Content-Type": "application/json"}
+        if pool:
+            headers["X-Spark-Pool"] = pool
         req = urllib.request.Request(
             self.url + path,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
+            data=json.dumps(payload).encode(), headers=headers)
         try:
             with urllib.request.urlopen(req,
                                         timeout=self.timeout) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             detail = json.loads(e.read())
+            if e.code == 429:
+                ra = e.headers.get("Retry-After") \
+                    or detail.get("retry_after_s") or 0.0
+                raise _RetryableHTTP(
+                    f"429 {detail.get('message')}",
+                    retry_after_s=float(ra)) from None
             msg = f"{detail.get('error')}: {detail.get('message')}"
             tb = detail.get("traceback")
             if tb:
                 msg += f"\n--- server traceback ---\n{tb}"
             raise RuntimeError(msg) from None
         except urllib.error.URLError as e:
-            if isinstance(getattr(e, "reason", None),
-                          (socket.timeout, TimeoutError)):
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, (socket.timeout, TimeoutError)):
                 raise RuntimeError(
                     f"DEADLINE_EXCEEDED: connect request to "
                     f"{self.url + path} timed out after "
                     f"{self.timeout:g}s") from e
+            if isinstance(reason, (ConnectionRefusedError,
+                                   ConnectionResetError,
+                                   ConnectionAbortedError,
+                                   BrokenPipeError)):
+                raise reason  # unwrapped: the retry loop classifies it
             raise
         except (socket.timeout, TimeoutError) as e:
             raise RuntimeError(
@@ -168,8 +281,33 @@ class Client:
                 f"{self.timeout:g}s") from e
         return pa.ipc.open_stream(io.BytesIO(data)).read_all()
 
-    def sql(self, query: str) -> pa.Table:
-        return self._post("/sql", {"query": query})
+    def sql(self, query: str, pool: Optional[str] = None,
+            deadline_s: Optional[float] = None) -> pa.Table:
+        payload = {"query": query}
+        if deadline_s is not None:
+            payload["deadline_s"] = float(deadline_s)
+        return self._post("/sql", payload, pool=pool)
+
+    def queries(self) -> dict:
+        """Scheduler status + recent query lifecycle records."""
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/queries",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def cancel(self, query_id: int) -> bool:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.url}/cancel/{int(query_id)}", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return bool(json.loads(resp.read()).get("cancelled"))
+        except Exception:
+            return False
 
     def tables(self):
         import urllib.request

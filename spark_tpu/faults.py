@@ -23,6 +23,9 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          (streaming/execution.py)
 - ``connect.request``    the connect server's HTTP request handling
                          (connect/server.py)
+- ``scheduler.admit``    the multi-tenant scheduler's HBM admission
+                         decision (scheduler/scheduler.py), fired as a
+                         query passes the device-admission gate
 
 Spec grammar (the conf value):
 
@@ -72,6 +75,7 @@ POINTS = (
     "exchange.all_to_all",
     "streaming.commit",
     "connect.request",
+    "scheduler.admit",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

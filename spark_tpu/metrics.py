@@ -42,11 +42,13 @@ def _log_path() -> Optional[str]:
         return None
     if not d:
         return None
-    # resolve + mkdir once per configured directory
-    if d not in _PATH_CACHE:
-        os.makedirs(d, exist_ok=True)
-        _PATH_CACHE[d] = os.path.join(d, "events.jsonl")
-    return _PATH_CACHE[d]
+    # resolve + mkdir once per configured directory (under the IO lock:
+    # concurrent queries must not race the mkdir/cache fill)
+    with _IO_LOCK:
+        if d not in _PATH_CACHE:
+            os.makedirs(d, exist_ok=True)
+            _PATH_CACHE[d] = os.path.join(d, "events.jsonl")
+        return _PATH_CACHE[d]
 
 
 def record(kind: str, **fields: Any) -> None:
@@ -68,7 +70,10 @@ def record(kind: str, **fields: Any) -> None:
 def query_start(description: str) -> int:
     with _LOCK:
         mark = _counter
-    _QUERY_MARKS.append(mark)
+        # mark append stays inside the lock: with concurrent queries an
+        # interleaved record() would otherwise skew which events
+        # last_query() attributes to the newest query
+        _QUERY_MARKS.append(mark)
     record("query_start", description=description)
     return mark
 

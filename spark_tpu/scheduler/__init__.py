@@ -1,0 +1,35 @@
+"""Multi-tenant query scheduler (reference: core/.../scheduler/
+TaskSchedulerImpl.scala + Pool.scala, lifted from task level to query
+level): fair scheduling pools, HBM admission control, and concurrent
+serving for the connect server.
+
+The subsystem has three parts:
+
+- ``pool``       FIFO / weighted-fair pools configured via
+                 ``spark.scheduler.mode`` and
+                 ``spark.tpu.scheduler.pool.<name>.{weight,minShare}``
+- ``admission``  a shared device-bytes budget; queries are admitted to
+                 device execution only while their estimated HBM
+                 footprints fit (over-budget queries admit alone and
+                 lean on the chunked/OOM-degradation ladder)
+- ``scheduler``  the query lifecycle (QUEUED -> ADMITTED -> RUNNING ->
+                 FINISHED/FAILED/CANCELLED), a host-side worker pool,
+                 cancellation, deadlines, and per-query metrics
+"""
+
+from spark_tpu.scheduler.admission import (AdmissionController,
+                                           estimate_plan_bytes)
+from spark_tpu.scheduler.pool import Pool, build_pools
+from spark_tpu.scheduler.scheduler import (QueryCancelled, QueryScheduler,
+                                           QueryTicket, SchedulerQueueFull)
+
+__all__ = [
+    "AdmissionController",
+    "estimate_plan_bytes",
+    "Pool",
+    "build_pools",
+    "QueryCancelled",
+    "QueryScheduler",
+    "QueryTicket",
+    "SchedulerQueueFull",
+]

@@ -1,0 +1,95 @@
+"""HBM admission control: a shared device-bytes budget that decides
+*when* a query may touch the device and under *what* memory budget.
+
+The footprint estimate reuses the cost model the join reorderer already
+trusts (plan/join_reorder.estimate_rows — exact at Parquet/batch
+leaves, heuristic above) times the schema row width, taken as the MAX
+over plan nodes: the widest intermediate a plan materializes is what
+actually presses HBM, not its (often tiny, post-aggregate) output.
+
+Admission is deliberately optimistic at the edges, mirroring the
+chunk pipeline's prefetch cap (conf.PREFETCH_BYTES_MAX): a query larger
+than the whole budget is still admitted when the device is otherwise
+idle — charged the full budget so nothing else co-runs — and relies on
+the existing chunked/OOM-degradation ladder
+(recovery.run_plan_with_oom_degradation) to survive. Refusing it
+outright would make over-budget queries unservable even on an idle
+device.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from spark_tpu import conf as CF
+
+#: floor on any footprint estimate — below this the estimate noise
+#: exceeds the signal and admission decisions would thrash
+MIN_ESTIMATE_BYTES = 64 * 1024
+
+
+def estimate_plan_bytes(plan, conf) -> int:
+    """Estimated device footprint of executing ``plan``: max over plan
+    nodes of estimated rows x 8-byte columns (x64 engine). Falls back
+    to the device batch budget when estimation fails — unknown plans
+    admit serially rather than stampeding HBM."""
+    from spark_tpu.physical.chunked import MAX_DEVICE_BATCH_BYTES
+
+    try:
+        from spark_tpu.plan.join_reorder import estimate_rows
+
+        def node_bytes(node) -> float:
+            try:
+                width = 8 * max(1, len(node.schema.names))
+            except Exception:
+                width = 8
+            own = estimate_rows(node) * width
+            return max([own] + [node_bytes(c) for c in node.children()])
+
+        est = int(node_bytes(plan))
+    except Exception:
+        est = int(conf.get(MAX_DEVICE_BATCH_BYTES))
+    return max(MIN_ESTIMATE_BYTES, est)
+
+
+class AdmissionController:
+    """Byte-budget gate. ``fits``/``acquire`` are lock-protected; the
+    scheduler holds its own condition around them, so the controller
+    itself never blocks."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = max(1, int(budget_bytes))
+        self._lock = threading.Lock()
+        self._in_use = 0
+        self._admitted = 0
+
+    def charge_for(self, nbytes: int) -> int:
+        """What an admission of ``nbytes`` costs: capped at the whole
+        budget so an over-budget query can still admit alone."""
+        return min(max(1, int(nbytes)), self.budget)
+
+    def fits(self, nbytes: int) -> bool:
+        with self._lock:
+            if self._admitted == 0:
+                return True  # idle device: always make progress
+            return self._in_use + self.charge_for(nbytes) <= self.budget
+
+    def acquire(self, nbytes: int) -> int:
+        """Charge the budget; returns the charge to pass to release().
+        Caller must have checked fits() under the scheduler lock."""
+        charge = self.charge_for(nbytes)
+        with self._lock:
+            self._in_use += charge
+            self._admitted += 1
+        return charge
+
+    def release(self, charge: int) -> None:
+        with self._lock:
+            self._in_use = max(0, self._in_use - int(charge))
+            self._admitted = max(0, self._admitted - 1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"budget_bytes": self.budget,
+                    "in_use_bytes": self._in_use,
+                    "admitted": self._admitted}

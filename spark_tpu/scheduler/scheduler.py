@@ -1,0 +1,489 @@
+"""Query-level scheduler: lifecycle, worker pool, device admission.
+
+Replaces the connect server's global ``_exec_lock`` (one slow
+aggregation used to block every other client) with a real control
+plane, the query-level analogue of the reference's
+TaskSchedulerImpl.scala + Pool.scala:
+
+- queries are submitted into named pools and move through
+  QUEUED -> ADMITTED -> RUNNING -> FINISHED/FAILED/CANCELLED;
+- a bounded worker pool runs host-side stages (parse, optimize,
+  parquet decode via the chunk pipeline) concurrently across queries;
+- device execution is gated by HBM admission control (admission.py):
+  a query passes the gate only when its estimated footprint fits the
+  shared budget AND it is the policy-best waiter — FIFO by submit
+  order, FAIR by per-pool device-time/weight stride. Grants are
+  strictly in policy order (no bypass), so a large query can wait for
+  the budget to drain but can never starve behind a stream of small
+  ones;
+- the queue is bounded: a submit at full depth raises
+  SchedulerQueueFull immediately (the connect server turns that into
+  429 + Retry-After) — backpressure, never an unbounded backlog;
+- ``scheduler.admit`` is a fault-injection seam: transient faults
+  retry the admission (bounded by spark.stage.maxConsecutiveAttempts),
+  injected OOM halves the query's footprint estimate down to the
+  degradation floor (the admission-side rung of the OOM ladder;
+  execution keeps its own run_plan_with_oom_degradation rungs), and
+  corruption surfaces typed and unretried.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from spark_tpu import conf as CF
+from spark_tpu import faults, metrics
+from spark_tpu.scheduler.admission import (AdmissionController,
+                                           estimate_plan_bytes)
+from spark_tpu.scheduler.pool import PoolRegistry
+
+QUEUED = "QUEUED"
+ADMITTED = "ADMITTED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+#: states a ticket can still leave
+_LIVE = (QUEUED, ADMITTED, RUNNING)
+
+
+class SchedulerQueueFull(RuntimeError):
+    """Submit rejected: the bounded queue is at depth. Carries the
+    Retry-After hint the connect server forwards with its 429."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"scheduler queue full ({depth} queued); retry after "
+            f"{retry_after_s:g}s")
+        self.retry_after_s = float(retry_after_s)
+
+
+class QueryCancelled(RuntimeError):
+    """The query was cancelled (explicitly or by its deadline)."""
+
+
+class QueryTicket:
+    """Handle for one submitted query: state, result, cancellation."""
+
+    def __init__(self, qid: int, *, pool: str, description: str,
+                 run: Callable, prepare: Optional[Callable],
+                 est_bytes: int, deadline: Optional[float]):
+        self.id = qid
+        self.pool = pool
+        self.description = description
+        self.est_bytes = int(est_bytes)
+        self.deadline = deadline  # absolute time.time(), or None
+        self.state = QUEUED
+        self.submitted_t = time.time()
+        self.admitted_t: Optional[float] = None
+        self.started_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.device_ms = 0.0
+        self.error: Optional[BaseException] = None
+        self._run = run
+        self._prepare = prepare
+        self._result: Any = None
+        self._done = threading.Event()
+        self._cancel = threading.Event()
+        self._charge = 0  # admission bytes currently held
+
+    # -- client surface ------------------------------------------------------
+
+    def cancel(self) -> bool:
+        """Request cancellation. Queued queries are cancelled
+        immediately by the scheduler; running queries observe it at
+        their next ``check_cancelled()`` seam. Returns False when the
+        query already finished."""
+        if self._done.is_set():
+            return False
+        self._cancel.set()
+        return True
+
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def check_cancelled(self) -> None:
+        """Cooperative cancellation/deadline seam for running queries."""
+        if self._cancel.is_set():
+            raise QueryCancelled(f"query {self.id} cancelled")
+        if self.deadline is not None and time.time() > self.deadline:
+            raise QueryCancelled(
+                f"DEADLINE_EXCEEDED: query {self.id} passed its "
+                f"deadline")
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block until the query finishes; raise its error if it
+        FAILED or was CANCELLED."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.id} still {self.state} after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self._result
+
+    def queue_wait_ms(self) -> float:
+        end = self.admitted_t or self.finished_t or time.time()
+        return max(0.0, (end - self.submitted_t) * 1e3)
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "id": self.id, "pool": self.pool,
+            "description": self.description[:200],
+            "state": self.state, "est_bytes": self.est_bytes,
+            "submitted": round(self.submitted_t, 3),
+            "queue_wait_ms": round(self.queue_wait_ms(), 2),
+            "device_ms": round(self.device_ms, 2),
+            "error": repr(self.error) if self.error is not None
+            else None,
+        }
+
+
+class QueryScheduler:
+    """The control plane. One per serving session (the connect server
+    builds one and registers it on the session for the UI)."""
+
+    def __init__(self, session=None, conf=None):
+        if conf is None:
+            conf = session.conf if session is not None else CF.RuntimeConf()
+        self._conf = conf
+        self._session = session
+        self.mode = str(conf.get(CF.SCHEDULER_MODE)).upper()
+        if self.mode not in ("FIFO", "FAIR"):
+            raise ValueError(
+                f"spark.scheduler.mode must be FIFO or FAIR, got "
+                f"{self.mode!r}")
+        self.queue_depth = max(0, int(conf.get(CF.SCHEDULER_QUEUE_DEPTH)))
+        self.retry_after_s = float(conf.get(CF.SCHEDULER_RETRY_AFTER))
+        self.pools = PoolRegistry(conf)
+        self.admission = AdmissionController(
+            int(conf.get(CF.SCHEDULER_HBM_BUDGET)))
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._queued = 0
+        self._gate: List[QueryTicket] = []  # waiting for device admission
+        self._recent: deque = deque(maxlen=256)  # finished + live tickets
+        self._stopped = False
+        self.rejected = 0
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"spark-tpu-sched-{i}")
+            for i in range(max(1, int(
+                conf.get(CF.SCHEDULER_MAX_CONCURRENCY))))]
+        for w in self._workers:
+            w.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, run: Callable, *, prepare: Optional[Callable] = None,
+               pool: Optional[str] = None, description: str = "",
+               est_bytes: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> QueryTicket:
+        """Queue a query. ``prepare(ticket)`` is the host-side stage
+        (parse/optimize/estimate; runs concurrently on the worker pool,
+        may return a refined est_bytes); ``run(ticket)`` is the
+        device-side stage, entered only after HBM admission. Raises
+        SchedulerQueueFull at full queue depth."""
+        p = self.pools.get(pool)
+        deadline = time.time() + float(deadline_s) \
+            if deadline_s is not None else None
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("scheduler is stopped")
+            if self._queued >= self.queue_depth:
+                self.rejected += 1
+                metrics.record("scheduler", phase="rejected",
+                               pool=p.name, queued=self._queued)
+                raise SchedulerQueueFull(self._queued, self.retry_after_s)
+            self._seq += 1
+            t = QueryTicket(
+                self._seq, pool=p.name, description=description,
+                run=run, prepare=prepare,
+                est_bytes=est_bytes if est_bytes is not None
+                else self.admission.budget,
+                deadline=deadline)
+            p.queue.append(t)
+            p.running += 1  # dequeued-or-queued live count, see _finish
+            self._queued += 1
+            self._recent.append(t)
+            metrics.record("scheduler", phase="submitted", id=t.id,
+                           pool=p.name, est_bytes=t.est_bytes)
+            self._cond.notify_all()
+        return t
+
+    def submit_query(self, build_df: Callable[[], Any], *,
+                     pool: Optional[str] = None, description: str = "",
+                     deadline_s: Optional[float] = None) -> QueryTicket:
+        """Engine-query convenience: ``build_df()`` -> DataFrame is the
+        host-side parse/plan stage (its footprint is then estimated
+        from the logical plan); the device stage materializes Arrow."""
+        holder: dict = {}
+
+        def prepare(t: QueryTicket):
+            df = build_df()
+            holder["df"] = df
+            conf = df._session.conf if df._session is not None \
+                else self._conf
+            return estimate_plan_bytes(df._plan, conf)
+
+        def run(t: QueryTicket):
+            t.check_cancelled()
+            return holder["df"].toArrow()
+
+        return self.submit(run, prepare=prepare, pool=pool,
+                           description=description, deadline_s=deadline_s)
+
+    def cancel(self, qid: int) -> bool:
+        """Cancel by id: a QUEUED query finishes CANCELLED right here;
+        an ADMITTED/RUNNING one is flagged for its next seam."""
+        with self._cond:
+            t = next((x for x in self._recent if x.id == qid), None)
+            if t is None or t.done():
+                return False
+            t._cancel.set()
+            if t.state == QUEUED:
+                p = self.pools.get(t.pool)
+                if t in p.queue:
+                    p.queue.remove(t)
+                    self._queued -= 1
+                    self._finish_locked(
+                        t, CANCELLED,
+                        error=QueryCancelled(
+                            f"query {t.id} cancelled while queued"))
+            self._cond.notify_all()
+            return True
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "mode": self.mode,
+                "queue_depth": self.queue_depth,
+                "queued": self._queued,
+                "gate_waiters": len(self._gate),
+                "rejected": self.rejected,
+                "admission": self.admission.snapshot(),
+                "pools": [p.snapshot() for p in self.pools.all()],
+            }
+
+    def describe(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Recent + live tickets, newest first (the /queries payload)."""
+        with self._cond:
+            return [t.info() for t in list(self._recent)[-n:]][::-1]
+
+    # -- worker side ---------------------------------------------------------
+
+    def _pick_locked(self) -> Optional[QueryTicket]:
+        """Next ticket to dequeue, per policy; purges cancelled and
+        deadline-expired queue heads. Caller holds the lock."""
+        now = time.time()
+        for p in self.pools.all():
+            while p.queue:
+                head = p.queue[0]
+                if head.cancelled() or (head.deadline is not None
+                                        and now > head.deadline):
+                    p.queue.popleft()
+                    self._queued -= 1
+                    why = "cancelled while queued" if head.cancelled() \
+                        else "DEADLINE_EXCEEDED while queued"
+                    self._finish_locked(
+                        head, CANCELLED,
+                        error=QueryCancelled(f"query {head.id} {why}"))
+                    continue
+                break
+        candidates = [p for p in self.pools.all() if p.queue]
+        if not candidates:
+            return None
+        if self.mode == "FAIR":
+            best = min(candidates, key=lambda p: p.fair_rank())
+        else:
+            best = min(candidates, key=lambda p: p.queue[0].id)
+        t = best.queue.popleft()
+        self._queued -= 1
+        return t
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                t = None
+                while not self._stopped:
+                    t = self._pick_locked()
+                    if t is not None:
+                        break
+                    self._cond.wait(0.1)
+                if self._stopped:
+                    return
+            self._execute(t)
+
+    def _execute(self, t: QueryTicket) -> None:
+        try:
+            t.check_cancelled()
+            if t._prepare is not None:
+                # host-side stage: runs concurrently across workers
+                est = t._prepare(t)
+                if est:
+                    t.est_bytes = int(est)
+            self._admit(t)
+            t.state = RUNNING
+            t.started_t = time.time()
+            t.check_cancelled()
+            out = t._run(t)
+            self._finish(t, FINISHED, result=out)
+        except QueryCancelled as e:
+            self._finish(t, CANCELLED, error=e)
+        except Exception as e:  # noqa: BLE001 — typed via ticket.error
+            self._finish(t, FAILED, error=e)
+        finally:
+            self._release(t)
+            with self._cond:
+                self._cond.notify_all()
+
+    # -- the device-admission gate -------------------------------------------
+
+    def _gate_best_locked(self) -> Optional[QueryTicket]:
+        if not self._gate:
+            return None
+        if self.mode == "FAIR":
+            return min(self._gate, key=lambda x:
+                       self.pools.get(x.pool).fair_rank() + (x.id,))
+        return min(self._gate, key=lambda x: x.id)
+
+    def _admit(self, t: QueryTicket) -> None:
+        """Pass the HBM admission gate, then the ``scheduler.admit``
+        fault seam: transient faults re-admit (bounded attempts),
+        injected OOM halves the footprint estimate down to the
+        degradation floor, corruption surfaces typed."""
+        from spark_tpu import recovery
+
+        attempts = max(1, int(self._conf.get(recovery.STAGE_MAX_ATTEMPTS)))
+        floor = max(1, int(self._conf.get(recovery.OOM_DEGRADE_FLOOR)))
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            self._gate_wait(t)
+            try:
+                faults.inject("scheduler.admit", self._conf)
+                if attempt:
+                    metrics.record("fault_recovered",
+                                   point="scheduler.admit",
+                                   how="admit_retry", attempts=attempt)
+                t.state = ADMITTED
+                t.admitted_t = time.time()
+                metrics.record("scheduler", phase="admitted", id=t.id,
+                               pool=t.pool, est_bytes=t.est_bytes,
+                               queue_wait_ms=round(t.queue_wait_ms(), 2))
+                return
+            except Exception as e:
+                self._release(t)
+                with self._cond:
+                    self._cond.notify_all()
+                last = e
+                if recovery.is_oom(e):
+                    # admission-side degradation rung: shrink the
+                    # claimed footprint; execution's own OOM ladder
+                    # (run_plan_with_oom_degradation) guards the rest
+                    if t.est_bytes // 2 < floor:
+                        raise
+                    t.est_bytes //= 2
+                    metrics.record("scheduler", phase="admit_degraded",
+                                   id=t.id, pool=t.pool,
+                                   est_bytes=t.est_bytes)
+                    continue
+                if recovery.is_transient(e):
+                    metrics.record("stage_retry",
+                                   label="scheduler.admit",
+                                   attempt=attempt, error=repr(e))
+                    continue
+                raise
+        raise RuntimeError(
+            f"scheduler.admit failed {attempts} consecutive attempts "
+            f"(last: {last!r})") from last
+
+    def _gate_wait(self, t: QueryTicket) -> None:
+        """Block until this ticket is the policy-best gate waiter AND
+        its estimate fits the budget; acquires the admission charge."""
+        with self._cond:
+            self._gate.append(t)
+            try:
+                while True:
+                    t.check_cancelled()
+                    if self._stopped:
+                        raise QueryCancelled(
+                            f"query {t.id} cancelled: scheduler stopped")
+                    if (self._gate_best_locked() is t
+                            and self.admission.fits(t.est_bytes)):
+                        t._charge = self.admission.acquire(t.est_bytes)
+                        self.pools.get(t.pool).device_running += 1
+                        t._gate_t0 = time.perf_counter()
+                        return
+                    self._cond.wait(0.05)
+            finally:
+                self._gate.remove(t)
+
+    def _release(self, t: QueryTicket) -> None:
+        if t._charge:
+            self.admission.release(t._charge)
+            t._charge = 0
+            elapsed_ms = (time.perf_counter() - t._gate_t0) * 1e3
+            t.device_ms += elapsed_ms
+            with self._cond:
+                p = self.pools.get(t.pool)
+                p.device_ms += elapsed_ms
+                p.device_running -= 1
+
+    # -- lifecycle end -------------------------------------------------------
+
+    def _finish(self, t: QueryTicket, state: str, result=None,
+                error: Optional[BaseException] = None) -> None:
+        self._release(t)
+        with self._cond:
+            self._finish_locked(t, state, result=result, error=error)
+
+    def _finish_locked(self, t: QueryTicket, state: str, result=None,
+                       error: Optional[BaseException] = None) -> None:
+        if t.done():
+            return
+        t.state = state
+        t._result = result
+        t.error = error
+        t.finished_t = time.time()
+        p = self.pools.get(t.pool)
+        p.running -= 1
+        if state == FINISHED:
+            p.finished += 1
+        metrics.record("scheduler", phase=state.lower(), id=t.id,
+                       pool=t.pool,
+                       queue_wait_ms=round(t.queue_wait_ms(), 2),
+                       device_ms=round(t.device_ms, 2),
+                       error=repr(error) if error is not None else None)
+        t._done.set()
+
+    # -- shutdown ------------------------------------------------------------
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop workers; queued queries finish CANCELLED, running ones
+        are flagged and joined briefly (daemon threads — a wedged query
+        cannot wedge interpreter exit)."""
+        with self._cond:
+            self._stopped = True
+            for p in self.pools.all():
+                while p.queue:
+                    t = p.queue.popleft()
+                    self._queued -= 1
+                    self._finish_locked(
+                        t, CANCELLED,
+                        error=QueryCancelled(
+                            f"query {t.id} cancelled: scheduler stopped"))
+            for t in self._recent:
+                if not t.done():
+                    t._cancel.set()
+            self._cond.notify_all()
+        deadline = time.time() + timeout
+        for w in self._workers:
+            w.join(max(0.0, deadline - time.time()))

@@ -179,6 +179,50 @@ def format_fault_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
+def scheduler_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up multi-tenant scheduler events into per-pool serving
+    stats: {pool: {submitted, admitted, finished, failed, cancelled,
+    rejected, admit_degraded, queue_wait_ms, queue_wait_max_ms,
+    device_ms}} — the query-level analogue of the reference's
+    fair-scheduler pool table in the UI."""
+    evs = events if events is not None else metrics.recent(4096)
+    out: Dict[str, dict] = {}
+    for e in evs:
+        if e.get("kind") != "scheduler":
+            continue
+        pool = e.get("pool", "?")
+        rec = out.setdefault(pool, {
+            "submitted": 0, "admitted": 0, "finished": 0, "failed": 0,
+            "cancelled": 0, "rejected": 0, "admit_degraded": 0,
+            "queue_wait_ms": 0.0, "queue_wait_max_ms": 0.0,
+            "device_ms": 0.0})
+        phase = e.get("phase")
+        if phase in rec:
+            rec[phase] += 1
+        if phase in ("finished", "failed", "cancelled"):
+            qw = float(e.get("queue_wait_ms", 0.0))
+            rec["queue_wait_ms"] = round(rec["queue_wait_ms"] + qw, 3)
+            rec["queue_wait_max_ms"] = round(
+                max(rec["queue_wait_max_ms"], qw), 3)
+            rec["device_ms"] = round(
+                rec["device_ms"] + float(e.get("device_ms", 0.0)), 3)
+    return out
+
+
+def format_scheduler_profile(
+        profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else scheduler_profile()
+    if not p:
+        return "(no scheduler events recorded)"
+    lines = ["pool        done fail canc rej   queue_wait_ms  device_ms"]
+    for pool, rec in sorted(p.items()):
+        lines.append(
+            f"{pool:<10} {rec['finished']:>5} {rec['failed']:>4} "
+            f"{rec['cancelled']:>4} {rec['rejected']:>3} "
+            f"{rec['queue_wait_ms']:>14.1f} {rec['device_ms']:>10.1f}")
+    return "\n".join(lines)
+
+
 class PlanningTracker:
     """Phase timing for the planning pipeline (reference:
     catalyst/QueryPlanningTracker.scala). Use as

@@ -42,6 +42,18 @@ UI_PORT = CF.register(
     "(reference: spark.ui.port).", int)
 
 
+def _scheduler_status(session) -> Optional[dict]:
+    """Queue depth + per-pool running counts when a query scheduler is
+    serving this session (the connect server registers one)."""
+    sched = getattr(session, "query_scheduler", None)
+    if sched is None:
+        return None
+    try:
+        return sched.status()
+    except Exception:
+        return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "spark-tpu-ui/1"
 
@@ -65,8 +77,22 @@ class _Handler(BaseHTTPRequestHandler):
         events = metrics.recent(int(q.get("n", ["5000"])[0]))
         if url.path in ("/", "/index.html"):
             queries = history.summarize_events(events)
-            self._send(200, history.render_html(queries).encode(),
-                       "text/html; charset=utf-8")
+            html = history.render_html(queries)
+            sched = _scheduler_status(
+                getattr(self.server, "spark_session", None))
+            if sched is not None:
+                block = (
+                    "<h2>Scheduler</h2><pre>"
+                    f"mode={sched['mode']} queued={sched['queued']} "
+                    f"rejected={sched['rejected']}\n"
+                    + "\n".join(
+                        f"pool {p['name']}: running={p['running']} "
+                        f"queued={p['queued']} weight={p['weight']} "
+                        f"device_ms={p['device_ms']}"
+                        for p in sched["pools"]) + "</pre>")
+                html = html.replace("</body>", block + "</body>") \
+                    if "</body>" in html else html + block
+            self._send(200, html.encode(), "text/html; charset=utf-8")
         elif url.path == "/api/v1/queries":
             self._json(history.summarize_events(events))
         elif url.path == "/api/v1/events":
@@ -84,6 +110,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "events": len(events),
                 "active_query": active,
                 "heartbeat": hb.status() if hb is not None else None,
+                "scheduler": _scheduler_status(session),
             })
         else:
             self._send(404, b"not found", "text/plain")

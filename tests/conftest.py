@@ -76,6 +76,36 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Deadlock guard: ``@pytest.mark.timeout(S)`` fails a test after S
+    seconds instead of hanging the whole tier-1 run (pytest-timeout is
+    not in the image; SIGALRM interrupts even a blocking lock acquire
+    on the main thread). Scheduler tests all carry it — a wedged queue
+    must fail fast, not wedge CI."""
+    import signal
+    import threading
+
+    marker = item.get_closest_marker("timeout")
+    seconds = float(marker.args[0]) if marker and marker.args else 0.0
+    if (seconds <= 0 or not hasattr(signal, "setitimer")
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _on_timeout(signum, frame):
+        pytest.fail(f"deadlock guard: test exceeded {seconds:g}s "
+                    f"(likely a wedged queue or gate)")
+
+    prev = signal.signal(signal.SIGALRM, _on_timeout)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,515 @@
+"""Multi-tenant query scheduler (spark_tpu/scheduler/): fair pools,
+HBM admission control, bounded-queue backpressure, cancellation, and
+concurrent serving through the connect server.
+
+Every test carries the ``timeout`` deadlock guard — a wedged queue or
+gate must fail fast, never hang tier-1.
+"""
+
+import json
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu import faults, metrics, tracing
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.scheduler import (AdmissionController, QueryCancelled,
+                                 QueryScheduler, SchedulerQueueFull,
+                                 build_pools, estimate_plan_bytes)
+
+pytestmark = pytest.mark.timeout(90)
+
+
+def make_scheduler(**overrides):
+    return QueryScheduler(conf=RuntimeConf(overrides))
+
+
+# ---- pools & policy ---------------------------------------------------------
+
+
+def test_pools_from_conf():
+    conf = RuntimeConf({
+        "spark.tpu.scheduler.pool.etl.weight": 2,
+        "spark.tpu.scheduler.pool.etl.minShare": 1,
+        "spark.tpu.scheduler.pool.adhoc.weight": 1,
+    })
+    pools = build_pools(conf)
+    assert pools["etl"].weight == 2 and pools["etl"].min_share == 1
+    assert pools["adhoc"].weight == 1
+    assert "default" in pools  # always present
+
+
+def test_bad_mode_rejected():
+    with pytest.raises(ValueError, match="FIFO or FAIR"):
+        make_scheduler(**{"spark.scheduler.mode": "LOTTERY"})
+
+
+def test_fifo_lifecycle_and_metrics():
+    sched = make_scheduler()
+    try:
+        t = sched.submit(lambda tk: 41 + 1, description="answer")
+        assert t.result(timeout=30) == 42
+        assert t.state == "FINISHED"
+        info = t.info()
+        assert info["pool"] == "default"
+        assert info["queue_wait_ms"] >= 0.0
+        assert any(q["id"] == t.id for q in sched.describe())
+        st = sched.status()
+        assert st["mode"] == "FIFO" and st["queued"] == 0
+        assert st["admission"]["in_use_bytes"] == 0
+    finally:
+        sched.stop()
+
+
+def test_fair_weight_ratio_under_contention():
+    """FAIR pools with weights 2:1 split device time ~2:1 under
+    contention (stride scheduling at the admission gate). Measured on
+    the steady-state delta between two snapshots so the startup
+    transient (the first dequeues land before any device_ms exists)
+    doesn't skew the ratio."""
+    sched = make_scheduler(**{
+        "spark.scheduler.mode": "FAIR",
+        "spark.tpu.scheduler.pool.a.weight": 2,
+        "spark.tpu.scheduler.pool.b.weight": 1,
+        "spark.tpu.scheduler.hbmBudgetBytes": 1024,  # serial device
+        "spark.tpu.scheduler.maxConcurrency": 4,
+        "spark.tpu.scheduler.queueDepth": 200,
+    })
+    try:
+        def work(tk):
+            time.sleep(0.008)
+
+        for _ in range(40):
+            sched.submit(work, pool="a")
+            sched.submit(work, pool="b")
+
+        def finished():
+            return (sched.pools.get("a").finished
+                    + sched.pools.get("b").finished)
+
+        def device_ms():
+            return (sched.pools.get("a").device_ms,
+                    sched.pools.get("b").device_ms)
+
+        deadline = time.time() + 60
+        while finished() < 8 and time.time() < deadline:
+            time.sleep(0.005)
+        a0, b0 = device_ms()
+        while finished() < 40 and time.time() < deadline:
+            time.sleep(0.005)
+        a1, b1 = device_ms()
+        assert finished() >= 40, "scheduler made no progress"
+        ratio = (a1 - a0) / max(1e-9, (b1 - b0))
+        # 2:1 within 25%
+        assert 1.5 <= ratio <= 2.67, f"device-time split {ratio:.2f}:1"
+    finally:
+        sched.stop()
+
+
+# ---- HBM admission ----------------------------------------------------------
+
+
+def test_admission_controller_budget():
+    ac = AdmissionController(4096)
+    assert ac.fits(2048)
+    c1 = ac.acquire(2048)
+    assert ac.fits(2048)
+    c2 = ac.acquire(2048)
+    assert not ac.fits(1)  # budget exhausted
+    ac.release(c1)
+    ac.release(c2)
+    # over-budget query admits alone, charged the whole budget
+    assert ac.fits(1 << 40)
+    c3 = ac.acquire(1 << 40)
+    assert c3 == 4096
+    assert not ac.fits(64)
+    ac.release(c3)
+    assert ac.snapshot()["in_use_bytes"] == 0
+
+
+def test_estimate_plan_bytes(spark):
+    df = spark.createDataFrame([{"k": i % 3, "v": i} for i in range(64)])
+    small = estimate_plan_bytes(df._plan, spark.conf)
+    assert small > 0
+    big = estimate_plan_bytes(
+        spark.range(1 << 20)._plan, spark.conf)
+    assert big >= 8 * (1 << 20)  # rows x 8-byte column
+
+
+def test_admission_gates_device_concurrency():
+    """With budget for exactly two footprints, a third query waits at
+    the gate; nothing exceeds the budget concurrently."""
+    sched = make_scheduler(**{
+        "spark.tpu.scheduler.hbmBudgetBytes": 4096,
+        "spark.tpu.scheduler.maxConcurrency": 4,
+    })
+    try:
+        lock = threading.Lock()
+        state = {"now": 0, "peak": 0}
+
+        def work(tk):
+            with lock:
+                state["now"] += 1
+                state["peak"] = max(state["peak"], state["now"])
+            time.sleep(0.05)
+            with lock:
+                state["now"] -= 1
+
+        tickets = [sched.submit(work, est_bytes=2048) for _ in range(4)]
+        for t in tickets:
+            t.result(timeout=30)
+        assert state["peak"] <= 2
+        assert state["peak"] == 2  # budget allowed pairs to co-run
+    finally:
+        sched.stop()
+
+
+# ---- bounded queue / backpressure ------------------------------------------
+
+
+def test_queue_full_rejects_submit():
+    sched = make_scheduler(**{
+        "spark.tpu.scheduler.maxConcurrency": 1,
+        "spark.tpu.scheduler.queueDepth": 1,
+        "spark.tpu.scheduler.retryAfterSeconds": 0.25,
+    })
+    try:
+        release = threading.Event()
+        blocker = sched.submit(lambda tk: release.wait(30))
+        deadline = time.time() + 30
+        while blocker.state != "RUNNING" and time.time() < deadline:
+            time.sleep(0.005)
+        queued = sched.submit(lambda tk: None)
+        with pytest.raises(SchedulerQueueFull) as ei:
+            sched.submit(lambda tk: None)
+        assert ei.value.retry_after_s == 0.25
+        release.set()
+        assert blocker.result(timeout=30) is True
+        queued.result(timeout=30)
+        assert sched.rejected == 1
+    finally:
+        release.set()
+        sched.stop()
+
+
+def test_server_returns_429_with_retry_after(spark):
+    """A full scheduler queue surfaces as HTTP 429 + Retry-After, not
+    an unbounded hang."""
+    from spark_tpu.connect.server import Client, ConnectServer
+
+    spark.createDataFrame(
+        [{"k": 1, "v": 2}]).createOrReplaceTempView("sched_429_t")
+    sched = QueryScheduler(conf=RuntimeConf({
+        "spark.tpu.scheduler.queueDepth": 0,
+        "spark.tpu.scheduler.retryAfterSeconds": 0.01,
+    }))
+    srv = ConnectServer(spark, port=0, scheduler=sched).start()
+    try:
+        c = Client(srv.url, retries=0)
+        with pytest.raises(RuntimeError, match="failed after 1 attempt"):
+            c.sql("select * from sched_429_t")
+        # with retries the client backs off per Retry-After, still 429
+        c2 = Client(srv.url, retries=2, backoff_s=0.005)
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="failed after 3 attempt"):
+            c2.sql("select * from sched_429_t")
+        assert time.time() - t0 >= 0.02  # honored the Retry-After floor
+    finally:
+        srv.stop()
+
+
+def test_client_retries_flapping_server():
+    """Bounded retry with exponential backoff: the client survives a
+    server that answers 429 twice and drops one connection before
+    serving the result."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from spark_tpu.connect.server import Client
+
+    tbl = pa.table({"a": [1, 2, 3]})
+    import io
+
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    arrow_bytes = sink.getvalue()
+    attempts = []
+
+    class Flapping(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            attempts.append(self.path)
+            n = len(attempts)
+            if n <= 2:  # backpressure twice
+                body = json.dumps({"error": "SchedulerQueueFull",
+                                   "message": "full",
+                                   "retry_after_s": 0.01}).encode()
+                self.send_response(429)
+                self.send_header("Retry-After", "0.01")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif n == 3:  # flap: drop the connection mid-request
+                self.connection.close()
+            else:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/vnd.apache.arrow.stream")
+                self.send_header("Content-Length",
+                                 str(len(arrow_bytes)))
+                self.end_headers()
+                self.wfile.write(arrow_bytes)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Flapping)
+    thr = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thr.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        c = Client(url, retries=4, backoff_s=0.005)
+        out = c.sql("select 1")
+        assert out.equals(tbl)
+        assert len(attempts) == 4
+        # a client out of retries surfaces the last error, bounded
+        attempts.clear()
+        c0 = Client(url, retries=1, backoff_s=0.005)
+        with pytest.raises(RuntimeError, match="failed after 2 attempt"):
+            c0.sql("select 1")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---- cancellation & deadlines ----------------------------------------------
+
+
+def test_cancel_mid_queue():
+    sched = make_scheduler(**{
+        "spark.tpu.scheduler.maxConcurrency": 1,
+        "spark.tpu.scheduler.queueDepth": 8,
+    })
+    try:
+        release = threading.Event()
+        blocker = sched.submit(lambda tk: release.wait(30))
+        deadline = time.time() + 30
+        while blocker.state != "RUNNING" and time.time() < deadline:
+            time.sleep(0.005)
+        queued = sched.submit(lambda tk: "never")
+        assert queued.state == "QUEUED"
+        assert sched.cancel(queued.id) is True
+        assert queued.state == "CANCELLED"
+        with pytest.raises(QueryCancelled):
+            queued.result(timeout=5)
+        release.set()
+        blocker.result(timeout=30)
+    finally:
+        release.set()
+        sched.stop()
+
+
+def test_cancel_mid_run():
+    sched = make_scheduler()
+    try:
+        started = threading.Event()
+
+        def work(tk):
+            started.set()
+            for _ in range(1000):  # cooperative cancellation seam
+                tk.check_cancelled()
+                time.sleep(0.005)
+            return "ran to completion"
+
+        t = sched.submit(work)
+        assert started.wait(30)
+        assert t.cancel() is True
+        with pytest.raises(QueryCancelled):
+            t.result(timeout=30)
+        assert t.state == "CANCELLED"
+    finally:
+        sched.stop()
+
+
+def test_deadline_expires_in_queue():
+    sched = make_scheduler(**{
+        "spark.tpu.scheduler.maxConcurrency": 1,
+    })
+    try:
+        release = threading.Event()
+        blocker = sched.submit(lambda tk: release.wait(30))
+        deadline = time.time() + 30
+        while blocker.state != "RUNNING" and time.time() < deadline:
+            time.sleep(0.005)
+        t = sched.submit(lambda tk: "late", deadline_s=0.05)
+        time.sleep(0.1)
+        release.set()
+        with pytest.raises(QueryCancelled, match="DEADLINE_EXCEEDED"):
+            t.result(timeout=30)
+        blocker.result(timeout=30)
+    finally:
+        release.set()
+        sched.stop()
+
+
+# ---- scheduler.admit fault injection ---------------------------------------
+
+
+def test_admit_fault_transient_recovers():
+    conf = RuntimeConf({
+        "spark.tpu.faultInjection.scheduler.admit": "nth:1",
+    })
+    sched = QueryScheduler(conf=conf)
+    try:
+        t = sched.submit(lambda tk: "ok")
+        assert t.result(timeout=30) == "ok"
+        assert faults.fire_count(conf, "scheduler.admit") == 1
+        kinds = [e["kind"] for e in metrics.recent(512)]
+        assert "fault_injected" in kinds
+    finally:
+        sched.stop()
+
+
+def test_admit_fault_oom_degrades_estimate():
+    conf = RuntimeConf({
+        "spark.tpu.faultInjection.scheduler.admit": "nth:1:oom",
+    })
+    sched = QueryScheduler(conf=conf)
+    try:
+        t = sched.submit(lambda tk: "ok", est_bytes=1 << 22)
+        assert t.result(timeout=30) == "ok"
+        # admission-side degradation rung halved the claimed footprint
+        assert t.est_bytes == 1 << 21
+        degr = [e for e in metrics.recent(512)
+                if e.get("kind") == "scheduler"
+                and e.get("phase") == "admit_degraded"]
+        assert degr and degr[-1]["est_bytes"] == 1 << 21
+    finally:
+        sched.stop()
+
+
+def test_admit_fault_corrupt_fails_typed():
+    conf = RuntimeConf({
+        "spark.tpu.faultInjection.scheduler.admit": "nth:1:corrupt",
+    })
+    sched = QueryScheduler(conf=conf)
+    try:
+        t = sched.submit(lambda tk: "ok")
+        with pytest.raises(faults.InjectedCorruptionError):
+            t.result(timeout=30)
+        assert t.state == "FAILED"
+    finally:
+        sched.stop()
+
+
+# ---- concurrent serving: byte-identical to serial ---------------------------
+
+
+STRESS_QUERIES = (
+    "select k, sum(v) as s, count(*) as n from st_a "
+    "group by k order by k",
+    "select a.k, a.v, b.w from st_a a join st_b b on a.k = b.k "
+    "order by a.v limit 20",
+    "select k, v from st_a where v > 10 order by v",
+    "select max(v) as mx, min(v) as mn, avg(v) as av from st_a",
+)
+
+
+def test_concurrent_results_byte_identical_to_serial(spark):
+    """8 concurrent clients replaying the query mix through the
+    connect server produce byte-identical Arrow to a serial replay —
+    the scheduler must never trade correctness for concurrency."""
+    from spark_tpu.connect.server import Client, ConnectServer
+
+    spark.createDataFrame(
+        [{"k": i % 5, "v": i} for i in range(200)]
+    ).createOrReplaceTempView("st_a")
+    spark.createDataFrame(
+        [{"k": i, "w": i * 7} for i in range(5)]
+    ).createOrReplaceTempView("st_b")
+
+    srv = ConnectServer(spark, port=0).start()
+    try:
+        serial = Client(srv.url)
+        ref = {q: serial.sql(q) for q in STRESS_QUERIES}
+
+        mismatches = []
+        errors = []
+
+        def client_loop(idx: int):
+            c = Client(srv.url, retries=3, backoff_s=0.01)
+            for _ in range(2):
+                for q in STRESS_QUERIES:
+                    try:
+                        out = c.sql(q)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+                        continue
+                    if not out.equals(ref[q]):
+                        mismatches.append((idx, q))
+
+        threads = [threading.Thread(target=client_loop, args=(i,),
+                                    daemon=True) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors[:3]
+        assert not mismatches, mismatches[:3]
+
+        # the lifecycle surface saw the traffic
+        q = serial.queries()
+        assert q["status"]["pools"]
+        assert any(rec["state"] == "FINISHED" for rec in q["queries"])
+    finally:
+        srv.stop()
+
+
+# ---- observability: profile, /health, UI -----------------------------------
+
+
+def test_scheduler_profile_rollup():
+    sched = make_scheduler()
+    try:
+        sched.submit(lambda tk: 1, pool="default").result(timeout=30)
+        prof = tracing.scheduler_profile()
+        assert prof.get("default", {}).get("finished", 0) >= 1
+        text = tracing.format_scheduler_profile(prof)
+        assert "default" in text
+    finally:
+        sched.stop()
+
+
+def test_health_and_ui_report_scheduler(spark):
+    import urllib.request
+
+    from spark_tpu import ui as UI
+    from spark_tpu.connect.server import Client, ConnectServer
+
+    srv = ConnectServer(spark, port=0).start()
+    ui_srv = UI.StatusServer(spark)
+    try:
+        h = Client(srv.url).health()
+        assert h["scheduler"]["queue_depth"] >= 0
+        assert any(p["name"] == "default"
+                   for p in h["scheduler"]["pools"])
+
+        with urllib.request.urlopen(
+                f"{ui_srv.url}/api/v1/status", timeout=10) as resp:
+            status = json.loads(resp.read())
+        assert status["scheduler"] is not None
+        assert "queued" in status["scheduler"]
+
+        with urllib.request.urlopen(ui_srv.url + "/",
+                                    timeout=10) as resp:
+            html = resp.read().decode()
+        assert "Scheduler" in html and "pool default" in html
+    finally:
+        ui_srv.stop()
+        srv.stop()
+
+
+def test_deadlock_guard_marker_registered(request):
+    """All scheduler tests run under the timeout deadlock guard."""
+    assert request.node.get_closest_marker("timeout") is not None
