@@ -313,13 +313,18 @@ class DataFrame:
         plan = self._plan
         if self._session is not None:
             from spark_tpu.recovery import run_stage_with_recovery
+            from spark_tpu.storage import pin_scope
 
-            plan = self._session.cache_manager.apply(plan, run_full)
-            # lineage recompute on transient environment failure
-            # (reference: DAGScheduler.scala:1762 stage resubmission)
-            return run_stage_with_recovery(
-                lambda: run_full(plan), conf=self._session.conf,
-                label=type(self._plan).__name__)
+            # pin_scope: every MemoryStore entry this query reads
+            # (cached plans, auto-cached scans) is held against
+            # eviction until the query finishes
+            with pin_scope():
+                plan = self._session.cache_manager.apply(plan, run_full)
+                # lineage recompute on transient environment failure
+                # (reference: DAGScheduler.scala:1762 stage resubmission)
+                return run_stage_with_recovery(
+                    lambda: run_full(plan), conf=self._session.conf,
+                    label=type(self._plan).__name__)
         return run_full(plan)
 
     def collect(self) -> List[Row]:

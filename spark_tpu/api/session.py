@@ -112,13 +112,51 @@ def _harden_cache_writes() -> None:
     _lru.LRUCache.put = put
 
 
+def _instrument_compile_cache() -> None:
+    """Count persistent compilation-cache hits/misses. jax's lookup
+    funnel is ``compilation_cache.get_executable_and_time`` — returns a
+    deserialized executable on a disk hit, None on a miss (followed by
+    a fresh XLA compile). Wrapping it feeds metrics.note_compile_cache
+    so warmup time is attributable: was the 6-55 s spent compiling or
+    loading AOT artifacts?"""
+    try:
+        from jax._src import compilation_cache as _cc
+    except Exception:
+        return
+    fn = getattr(_cc, "get_executable_and_time", None)
+    if fn is None or getattr(fn, "_spark_tpu_counted", False):
+        return
+
+    from spark_tpu import metrics as _metrics
+
+    def get_executable_and_time(*a, _orig=fn, **kw):
+        out = _orig(*a, **kw)
+        try:
+            executable = out[0] if isinstance(out, tuple) else out
+            _metrics.note_compile_cache(executable is not None)
+        except Exception:
+            pass
+        return out
+
+    get_executable_and_time._spark_tpu_counted = True
+    _cc.get_executable_and_time = get_executable_and_time
+
+
 class CacheManager:
     """Lazy in-memory plan cache (reference: CacheManager.scala +
     InMemoryRelation): cache() registers the logical plan; the first
-    execution materializes it to a device Batch, and every later query
-    whose tree contains a cached subplan scans the materialized batch
-    instead of recomputing. Identity is structural_key() — injective
-    plan structure plus leaf batch/source identity.
+    execution materializes it to a device Batch held in the
+    HBM-resident MemoryStore (storage/store.py), and every later query
+    whose tree contains a cached subplan scans the stored batch instead
+    of recomputing. Identity is structural_key() — injective plan
+    structure plus leaf batch/source identity.
+
+    Because the batches live in the byte-accounted store, cached plans
+    are EVICTABLE: execution admission or storage pressure may drop an
+    unpinned entry LRU-first, and the next query that needs it simply
+    re-materializes (the plan registration survives eviction — only
+    the bytes are reclaimed). uncache()/clear() remove the store entry
+    too, releasing its bytes immediately.
 
     Thread-safe: the registry mutates under a lock, and each entry
     materializes under its own per-entry lock (single-flight — two
@@ -126,8 +164,15 @@ class CacheManager:
     both materialize it; the registry lock is NOT held during the
     materializing run, so unrelated queries proceed)."""
 
-    def __init__(self):
-        # entry = [plan, materialized Relation | None, entry lock]
+    def __init__(self, store=None):
+        if store is None:
+            # standalone manager (tests / sessions built without a
+            # store): private unified budget, same code path
+            from spark_tpu.storage import MemoryStore, UnifiedMemoryManager
+
+            store = MemoryStore(UnifiedMemoryManager())
+        self._store = store
+        # entry = [plan, entry lock]
         self._entries: Dict[str, list] = {}
         self._lock = threading.Lock()
 
@@ -136,18 +181,32 @@ class CacheManager:
         # injective structural identity incl. leaf batch/source identity
         return plan.structural_key()
 
+    @staticmethod
+    def _skey(key):
+        # namespace cache entries apart from auto-cached scans, which
+        # share the store
+        return ("cache", key)
+
     def add(self, plan: L.LogicalPlan) -> None:
         with self._lock:
             self._entries.setdefault(
-                self._key(plan), [plan, None, threading.Lock()])
+                self._key(plan), [plan, threading.Lock()])
 
     def drop(self, plan: L.LogicalPlan) -> bool:
+        key = self._key(plan)
         with self._lock:
-            return self._entries.pop(self._key(plan), None) is not None
+            entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        self._store.remove(self._skey(key))  # releases the bytes
+        return True
 
     def clear(self) -> None:
         with self._lock:
+            keys = list(self._entries)
             self._entries.clear()
+        for key in keys:
+            self._store.remove(self._skey(key))
 
     def apply(self, plan: L.LogicalPlan, run) -> L.LogicalPlan:
         """Substitute cached subtrees, LARGEST first (top-down — the
@@ -161,15 +220,29 @@ class CacheManager:
             with self._lock:
                 entry = self._entries.get(self._key(node))
             if entry is not None:
-                if entry[1] is None:
-                    with entry[2]:  # single-flight materialization
-                        if entry[1] is None:
-                            entry[1] = L.Relation(run(entry[0]))
-                return entry[1]
+                return L.Relation(self._materialize(node, entry, run))
             children = tuple(go(c) for c in node.children())
             return node.with_children(children) if children else node
 
         return go(plan)
+
+    def _materialize(self, node: L.LogicalPlan, entry: list, run):
+        """Store-hit or single-flight recompute; pin=True holds the
+        batch for the duration of the enclosing query's pin_scope."""
+        skey = self._skey(self._key(node))
+        batch = self._store.get(skey, pin=True)
+        if batch is not None:
+            return batch
+        with entry[1]:  # single-flight materialization
+            batch = self._store.get(skey, pin=True)
+            if batch is not None:
+                return batch
+            batch = run(entry[0])
+            # a rejected put (cannot fit under the unified budget even
+            # after evicting the store's LRU tail) still serves THIS
+            # query its batch; the entry stays recomputable
+            self._store.put(skey, batch, pin=True)
+            return batch
 
 
 class Catalog:
@@ -300,10 +373,18 @@ class SparkSession:
         # SQL engines need 64-bit ints/floats; flip jax's default.
         jax.config.update("jax_enable_x64", True)
         _enable_compilation_cache()
+        _instrument_compile_cache()
         self.app_name = app_name
         self.conf = RuntimeConf(conf)
         self.catalog = Catalog(self)
-        self.cache_manager = CacheManager()
+        # unified storage/execution HBM accounting: the MemoryStore
+        # (cached/auto-cached batches) and the scheduler's admission
+        # controller share one budget (spark.tpu.scheduler.hbmBudgetBytes)
+        from spark_tpu.storage import MemoryStore, UnifiedMemoryManager
+
+        self.memory_manager = UnifiedMemoryManager(conf=self.conf)
+        self.memory_store = MemoryStore(self.memory_manager)
+        self.cache_manager = CacheManager(store=self.memory_store)
         self._stopped = False
         from spark_tpu.extensions import Extensions
 

@@ -159,6 +159,41 @@ SCHEDULER_DEFAULT_POOL = register(
 #:   spark.tpu.scheduler.pool.<name>.minShare  (int, default 0)
 SCHEDULER_POOL_PREFIX = "spark.tpu.scheduler.pool."
 
+# ---- HBM-resident columnar storage (spark_tpu/storage/) --------------------
+
+STORAGE_MAX_BYTES = register(
+    "spark.tpu.storage.maxBytes", 1 << 30,
+    "Cap on the storage region of the unified HBM budget: total device "
+    "bytes the MemoryStore may hold in cached columnar batches. The "
+    "effective cap is min(this, hbmBudgetBytes - execution grants) — "
+    "storage and execution share spark.tpu.scheduler.hbmBudgetBytes "
+    "(reference: spark.memory.fraction / UnifiedMemoryManager).", int)
+
+STORAGE_MIN_BYTES = register(
+    "spark.tpu.storage.minBytes", 64 * 1024 * 1024,
+    "Protected storage region: execution admission may evict unpinned "
+    "cached batches to make room, but never below this many bytes "
+    "(reference: spark.memory.storageFraction — the floor storage is "
+    "guaranteed against eviction by execution).", int)
+
+STORAGE_AUTOCACHE_THRESHOLD = register(
+    "spark.tpu.storage.autoCacheThreshold", 2,
+    "Auto-cache hot scans: a (source, columns, filters) scan that has "
+    "been materialized this many times in the session is promoted into "
+    "the HBM-resident MemoryStore (byte-accounted, LRU-evictable), so "
+    "repeat queries skip parquet decode + dictionary encode + "
+    "host->device transfer entirely. 0 disables auto-caching; explicit "
+    "df.cache() is unaffected.", int)
+
+JIT_STAGE_CACHE_ENTRIES = register(
+    "spark.tpu.jit.stageCacheEntries", 512,
+    "Entry cap for the fused-stage jit caches (single-device "
+    "physical/planner._STAGE_CACHE and distributed "
+    "parallel/executor._DIST_STAGE_CACHE). Compiled stage programs "
+    "beyond the cap are dropped LRU — an evicted plan recompiles on "
+    "next use. Live sizes are published as metrics gauges "
+    "jit_cache.<fused|dist>.entries.", int)
+
 
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""

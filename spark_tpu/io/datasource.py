@@ -168,6 +168,9 @@ class FileSource:
         self._dataset: Optional[pads.Dataset] = None
         self._cache: Dict[tuple, Batch] = {}
         self._count_cache: Dict[tuple, int] = {}
+        #: per-(columns, filters) materialization counts, driving
+        #: auto-cache promotion into the session MemoryStore
+        self._read_counts: Dict[tuple, int] = {}
 
     # -- dataset / schema ----------------------------------------------------
 
@@ -201,9 +204,12 @@ class FileSource:
         fp = self._fingerprint()
         if getattr(self, "_fp", None) != fp:
             # underlying files changed: drop dataset + batch/count caches
+            # (store entries key on the fingerprint, so they simply
+            # stop matching and age out LRU)
             self._dataset = None
             self._cache.clear()
             self._count_cache.clear()
+            self._read_counts.clear()
             self._fp = fp
         if self._dataset is not None:
             return self._dataset
@@ -270,22 +276,72 @@ class FileSource:
 
     # -- scanning ------------------------------------------------------------
 
+    def _session_store(self):
+        """(MemoryStore, auto-cache threshold) of the active session;
+        (None, 0) outside a session or with auto-caching disabled."""
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession.getActiveSession()
+        store = getattr(sess, "memory_store", None) if sess else None
+        if store is None:
+            return None, 0
+        from spark_tpu import conf as CF
+
+        try:
+            thr = int(sess.conf.get(CF.STORAGE_AUTOCACHE_THRESHOLD))
+        except Exception:
+            thr = 0
+        return (store, thr) if thr > 0 else (None, 0)
+
+    def _store_key(self, key) -> tuple:
+        # fingerprint in the key: a rewritten file misses naturally and
+        # the stale entry ages out LRU
+        return ("scan", self.fmt, tuple(self.paths), self._fp, key)
+
     def read(self, columns: Optional[Tuple[str, ...]] = None,
              filters: Tuple[E.Expression, ...] = ()) -> Batch:
         """Materialize the scan to a device Batch, reading only
-        ``columns`` and pruning/filtering by ``filters`` (exact)."""
+        ``columns`` and pruning/filtering by ``filters`` (exact).
+
+        Hot scans are auto-cached: once the same (columns, filters)
+        projection has materialized ``spark.tpu.storage.autoCacheThreshold``
+        times, its device batch is promoted into the session's
+        HBM-resident MemoryStore (byte-accounted, LRU-evictable, pinned
+        while the running query reads it), and repeat queries skip
+        parquet decode + dictionary encode + host->device transfer."""
+        import time as _time
+
+        from spark_tpu import metrics
         from spark_tpu.columnar.arrow import from_arrow
 
         ds = self._open()  # first: freshness check may clear the cache
         key = (columns, tuple(E.expr_key(f) for f in filters))
+        self._read_counts[key] = self._read_counts.get(key, 0) + 1
+        store, threshold = self._session_store()
+        skey = self._store_key(key) if store is not None else None
+        if store is not None:
+            hit = store.get(skey, pin=True)
+            if hit is not None:
+                return hit
+        hot = store is not None and self._read_counts[key] >= threshold
         hit = self._cache.get(key)
         if hit is not None:
             self._cache[key] = self._cache.pop(key)  # LRU touch
+            if hot and store.put(skey, hit, pin=True):
+                self._cache.pop(key, None)  # now owned by the store
             return hit
+        t0 = _time.perf_counter()
         table = ds.to_table(
             columns=list(columns) if columns is not None else None,
             filter=_filters_to_pads(filters, self._dtypes()))
-        batch = from_arrow(table)
+        t1 = _time.perf_counter()
+        batch = from_arrow(table)  # dict-encode + host->device transfer
+        t2 = _time.perf_counter()
+        metrics.record("scan", fmt=self.fmt, rows=table.num_rows,
+                       decode_ms=round((t1 - t0) * 1e3, 2),
+                       transfer_ms=round((t2 - t1) * 1e3, 2))
+        if hot and store.put(skey, batch, pin=True):
+            return batch
         # bounded LRU: parameterized pushed filters must not pin an
         # unbounded number of device-resident batches
         while len(self._cache) >= 4:

@@ -97,6 +97,42 @@ def reset() -> None:
         _QUERY_MARKS.clear()
 
 
+# ---- gauges -----------------------------------------------------------------
+
+#: last-set values for point-in-time measures (cache sizes, occupancy)
+#: that would flood the event ring if recorded per change
+_GAUGES: Dict[str, Any] = {}
+
+
+def set_gauge(name: str, value: Any) -> None:
+    with _LOCK:
+        _GAUGES[name] = value
+
+
+def gauges() -> Dict[str, Any]:
+    with _LOCK:
+        return dict(_GAUGES)
+
+
+# ---- persistent compile-cache counters --------------------------------------
+
+#: hit/miss counts for jax's persistent (disk) compilation cache —
+#: api/session wraps the jax lookup path to feed these; warmup_s was
+#: otherwise opaque (6-55 s per query with no sign whether XLA compiled
+#: fresh or loaded an AOT executable)
+_COMPILE_CACHE = {"hits": 0, "misses": 0}
+
+
+def note_compile_cache(hit: bool) -> None:
+    with _LOCK:
+        _COMPILE_CACHE["hits" if hit else "misses"] += 1
+
+
+def compile_cache_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_COMPILE_CACHE)
+
+
 class PipelineStats:
     """Wall-time accounting for the out-of-HBM chunk pipeline
     (physical/pipeline.py): per-stage totals (decode / filter /

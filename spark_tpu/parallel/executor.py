@@ -26,6 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
+from spark_tpu import conf as CF
 from spark_tpu import types as T
 from spark_tpu.columnar.batch import Batch
 from spark_tpu.expr import expressions as E
@@ -41,8 +42,11 @@ from spark_tpu.types import Schema
 _SPEC = PartitionSpec(DATA_AXIS)
 
 #: jit cache for stage programs, keyed on (plan structure, mesh shape,
-#: platform) — the CodeGenerator.compile cache analogue.
-_DIST_STAGE_CACHE: Dict[tuple, tuple] = {}
+#: platform) — the CodeGenerator.compile cache analogue. Bounded:
+#: spark.tpu.jit.stageCacheEntries, LRU beyond the cap.
+from spark_tpu.storage.lru import LruDict  # noqa: E402
+
+_DIST_STAGE_CACHE = LruDict("dist", CF.JIT_STAGE_CACHE_ENTRIES)
 
 
 @dataclass(eq=False)

@@ -24,6 +24,7 @@ from typing import Dict, List, Tuple
 import jax
 import numpy as np
 
+from spark_tpu import conf as CF
 from spark_tpu.columnar.batch import Batch
 from spark_tpu.expr import expressions as E
 from spark_tpu.physical import kernels as K
@@ -82,7 +83,11 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
 
 # ---- stage-fused execution --------------------------------------------------
 
-_STAGE_CACHE: Dict[tuple, tuple] = {}
+from spark_tpu.storage.lru import LruDict  # noqa: E402
+
+#: bounded: spark.tpu.jit.stageCacheEntries (LRU beyond the cap; an
+#: evicted plan recompiles on next use)
+_STAGE_CACHE = LruDict("fused", CF.JIT_STAGE_CACHE_ENTRIES)
 
 
 def _fully_traceable(plan: P.PhysicalPlan) -> bool:
@@ -190,10 +195,8 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
     _collect_scans(plan, scans)
     key = (plan.plan_key(), _adaptive_snapshot(plan))
     entry = _STAGE_CACHE.get(key)
-    if entry is None:
-        from spark_tpu import metrics
-
-        metrics.record("stage_compile", node=plan.node_string())
+    fresh = entry is None
+    if fresh:
         schema_box: dict = {}
         skeleton = _strip_leaf_data(plan)
 
@@ -213,7 +216,20 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
         entry = (jax.jit(stage_fn), schema_box)
         _STAGE_CACHE[key] = entry
     jitted, schema_box = entry
-    data = jitted(tuple(s.batch.data for s in scans))
+    if fresh:
+        # first call traces + XLA-compiles (or loads from the
+        # persistent disk cache — metrics.compile_cache_stats says
+        # which); timing it makes warmup attributable
+        import time
+
+        from spark_tpu import metrics
+
+        t0 = time.perf_counter()
+        data = jitted(tuple(s.batch.data for s in scans))
+        metrics.record("stage_compile", node=plan.node_string(),
+                       ms=round((time.perf_counter() - t0) * 1e3, 2))
+    else:
+        data = jitted(tuple(s.batch.data for s in scans))
     return Batch(schema_box["schema"], data)
 
 

@@ -53,43 +53,47 @@ def estimate_plan_bytes(plan, conf) -> int:
 
 
 class AdmissionController:
-    """Byte-budget gate. ``fits``/``acquire`` are lock-protected; the
-    scheduler holds its own condition around them, so the controller
-    itself never blocks."""
+    """Byte-budget gate over the EXECUTION side of the unified
+    storage/execution memory manager (storage/unified.py — the
+    UnifiedMemoryManager analogue). When the serving session holds an
+    HBM-resident MemoryStore, admission and cached storage share one
+    budget: an admission that does not fit first evicts unpinned cached
+    batches down to the protected ``spark.tpu.storage.minBytes``
+    region. ``fits``/``acquire`` are lock-protected; the scheduler
+    holds its own condition around them, so the controller itself never
+    blocks."""
 
-    def __init__(self, budget_bytes: int):
-        self.budget = max(1, int(budget_bytes))
-        self._lock = threading.Lock()
-        self._in_use = 0
-        self._admitted = 0
+    def __init__(self, budget_bytes: int, manager=None):
+        from spark_tpu.storage.unified import UnifiedMemoryManager
+
+        self._m = manager if manager is not None \
+            else UnifiedMemoryManager(budget_bytes)
+
+    @property
+    def budget(self) -> int:
+        return self._m.budget
+
+    @property
+    def manager(self):
+        """The shared UnifiedMemoryManager (storage attaches here)."""
+        return self._m
 
     def charge_for(self, nbytes: int) -> int:
         """What an admission of ``nbytes`` costs: capped at the whole
         budget so an over-budget query can still admit alone."""
-        return min(max(1, int(nbytes)), self.budget)
+        return self._m.charge_for(nbytes)
 
     def fits(self, nbytes: int) -> bool:
-        with self._lock:
-            if self._admitted == 0:
-                return True  # idle device: always make progress
-            return self._in_use + self.charge_for(nbytes) <= self.budget
+        return self._m.fits_execution(nbytes)
 
     def acquire(self, nbytes: int) -> int:
-        """Charge the budget; returns the charge to pass to release().
-        Caller must have checked fits() under the scheduler lock."""
-        charge = self.charge_for(nbytes)
-        with self._lock:
-            self._in_use += charge
-            self._admitted += 1
-        return charge
+        """Charge the budget (evicting unpinned storage if needed);
+        returns the charge to pass to release(). Caller must have
+        checked fits() under the scheduler lock."""
+        return self._m.acquire_execution(nbytes)
 
     def release(self, charge: int) -> None:
-        with self._lock:
-            self._in_use = max(0, self._in_use - int(charge))
-            self._admitted = max(0, self._admitted - 1)
+        self._m.release_execution(charge)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {"budget_bytes": self.budget,
-                    "in_use_bytes": self._in_use,
-                    "admitted": self._admitted}
+        return self._m.snapshot()

@@ -90,6 +90,8 @@ class QueryTicket:
         self._done = threading.Event()
         self._cancel = threading.Event()
         self._charge = 0  # admission bytes currently held
+        self._granted = False  # holding an admission grant (charge may
+        # legitimately be 0 when storage eviction covered the footprint)
 
     # -- client surface ------------------------------------------------------
 
@@ -162,8 +164,12 @@ class QueryScheduler:
         self.queue_depth = max(0, int(conf.get(CF.SCHEDULER_QUEUE_DEPTH)))
         self.retry_after_s = float(conf.get(CF.SCHEDULER_RETRY_AFTER))
         self.pools = PoolRegistry(conf)
+        # share the session's unified storage/execution memory manager
+        # when there is one, so admission can reclaim unpinned cached
+        # batches; a conf-only scheduler (tests) gets a private manager
         self.admission = AdmissionController(
-            int(conf.get(CF.SCHEDULER_HBM_BUDGET)))
+            int(conf.get(CF.SCHEDULER_HBM_BUDGET)),
+            manager=getattr(session, "memory_manager", None))
         self._cond = threading.Condition()
         self._seq = 0
         self._queued = 0
@@ -419,6 +425,7 @@ class QueryScheduler:
                     if (self._gate_best_locked() is t
                             and self.admission.fits(t.est_bytes)):
                         t._charge = self.admission.acquire(t.est_bytes)
+                        t._granted = True
                         self.pools.get(t.pool).device_running += 1
                         t._gate_t0 = time.perf_counter()
                         return
@@ -427,9 +434,10 @@ class QueryScheduler:
                 self._gate.remove(t)
 
     def _release(self, t: QueryTicket) -> None:
-        if t._charge:
+        if t._granted:
             self.admission.release(t._charge)
             t._charge = 0
+            t._granted = False
             elapsed_ms = (time.perf_counter() - t._gate_t0) * 1e3
             t.device_ms += elapsed_ms
             with self._cond:

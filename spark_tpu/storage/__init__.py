@@ -1,0 +1,28 @@
+"""HBM-resident columnar storage.
+
+The device-resident analogue of the reference's storage tier:
+InMemoryRelation / CachedBatch backed by the UnifiedMemoryManager's
+storage/execution split (reference:
+sql/core/.../execution/columnar/InMemoryRelation.scala,
+core/.../memory/UnifiedMemoryManager.scala:56). Materialized device
+``Batch``es (dict-encoded int32 codes + validity — exactly what
+``columnar/arrow.from_arrow`` produces) live in a byte-accounted
+``MemoryStore`` keyed by scan/plan structural identity; storage and
+execution share ONE HBM byte budget
+(``spark.tpu.scheduler.hbmBudgetBytes``) through the
+``UnifiedMemoryManager``: execution admission may evict unpinned
+storage entries down to ``spark.tpu.storage.minBytes``, and storage
+can never evict a running query's admission grant.
+"""
+
+from spark_tpu.storage.lru import LruDict
+from spark_tpu.storage.store import MemoryStore, StoreEntry, pin_scope
+from spark_tpu.storage.unified import UnifiedMemoryManager
+
+__all__ = [
+    "LruDict",
+    "MemoryStore",
+    "StoreEntry",
+    "UnifiedMemoryManager",
+    "pin_scope",
+]

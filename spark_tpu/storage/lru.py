@@ -1,0 +1,94 @@
+"""Bounded LRU mapping for jitted-stage caches.
+
+The fused-stage caches (physical/planner._STAGE_CACHE and
+parallel/executor._DIST_STAGE_CACHE) were unbounded dicts — a
+long-serving process compiling thousands of distinct plans pinned
+every compiled executable (and its leaf-stripped plan skeleton)
+forever. This wrapper gives them LRU semantics with an entry cap read
+LIVE from ``spark.tpu.jit.stageCacheEntries`` (active session conf, so
+serving deployments tune it without restarts) and publishes the live
+size as a metrics gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from spark_tpu import metrics
+
+
+class LruDict:
+    """Dict-shaped (get / [] / len / clear) so existing call sites keep
+    working; inserts evict oldest-accessed entries beyond the cap.
+    Thread-safe: scheduler workers share these caches."""
+
+    def __init__(self, name: str, cap_entry=None, cap: int = 512):
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._name = name
+        self._cap_entry = cap_entry  # conf.ConfigEntry, read live
+        self._cap = int(cap)
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    def _capacity(self) -> int:
+        if self._cap_entry is not None:
+            try:
+                from spark_tpu.api.session import SparkSession
+
+                sess = SparkSession.getActiveSession()
+                if sess is not None:
+                    return max(1, int(sess.conf.get(self._cap_entry)))
+                return max(1, int(self._cap_entry.default))
+            except Exception:
+                pass
+        return max(1, self._cap)
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                v = self._d[key]
+            except KeyError:
+                return default
+            self._d.move_to_end(key)
+            return v
+
+    def __getitem__(self, key):
+        with self._lock:
+            v = self._d[key]
+            self._d.move_to_end(key)
+            return v
+
+    def __setitem__(self, key, value) -> None:
+        cap = self._capacity()
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            evicted = 0
+            while len(self._d) > cap:
+                self._d.popitem(last=False)
+                evicted += 1
+            size = len(self._d)
+        if evicted:
+            self.evictions += evicted
+            metrics.record("jit_cache_evict", cache=self._name,
+                           evicted=evicted, size=size, cap=cap)
+        metrics.set_gauge(f"jit_cache.{self._name}.entries", size)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+        metrics.set_gauge(f"jit_cache.{self._name}.entries", 0)
+
+    def pop(self, key, default=None):
+        with self._lock:
+            return self._d.pop(key, default)

@@ -1,0 +1,267 @@
+"""The HBM-resident columnar store.
+
+The device-side analogue of the reference's block-manager storage tier
+for cached relations (reference: InMemoryRelation.scala CachedBatch +
+storage/memory/MemoryStore.scala:93): entries are fully materialized
+device ``Batch``es (dict-encoded int32 string codes + validity arrays,
+exactly the layout ``columnar/arrow.from_arrow`` ships to HBM), keyed
+by the scan/plan structural key, byte-accounted against the unified
+HBM budget (unified.py) and evicted LRU when storage or execution
+needs the room.
+
+Pinning: a query that is reading an entry pins it for the duration of
+its execution (``pin_scope`` wraps ``DataFrame._execute``); pinned
+entries are never evicted, so the bytes a running query depends on are
+never double-counted as reclaimable. Eviction drops the store's
+reference only — device buffers free when the last reader releases
+theirs, which is exactly what the pin protocol guarantees has
+happened by the time the accounting says the bytes are back.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, List, Optional
+
+from spark_tpu import metrics
+
+#: per-execution list of (store, key) pins, released when the query's
+#: pin_scope exits; None outside any scope (gets then don't pin)
+_PINS: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
+    "spark_tpu_storage_pins", default=None)
+
+
+@contextlib.contextmanager
+def pin_scope() -> Iterator[None]:
+    """Pin every store entry read inside the block until it exits —
+    one scope per query execution. Reentrant: an inner scope (cached
+    plan materialization running a sub-query) folds into the outer."""
+    if _PINS.get() is not None:
+        yield  # already inside a query's scope
+        return
+    token = _PINS.set([])
+    try:
+        yield
+    finally:
+        pins = _PINS.get()
+        _PINS.reset(token)
+        for store, key in pins or ():
+            store.unpin(key)
+
+
+class StoreEntry:
+    __slots__ = ("key", "batch", "nbytes", "pins", "hits", "created_t",
+                 "last_access_t")
+
+    def __init__(self, key, batch, nbytes: int):
+        self.key = key
+        self.batch = batch
+        self.nbytes = int(nbytes)
+        self.pins = 0
+        self.hits = 0
+        self.created_t = time.time()
+        self.last_access_t = self.created_t
+
+
+def batch_nbytes(batch) -> int:
+    """Device bytes of a store candidate; falls back to a schema-width
+    estimate for batch-likes without ``device_nbytes`` (mesh-sharded
+    results in tests)."""
+    try:
+        return int(batch.device_nbytes())
+    except Exception:
+        try:
+            return int(batch.capacity) * 8 * max(
+                1, len(batch.schema.names))
+        except Exception:
+            return 0
+
+
+class MemoryStore:
+    """Byte-accounted LRU cache of device batches, sharing its lock and
+    byte budget with the UnifiedMemoryManager it registers on."""
+
+    def __init__(self, manager):
+        self._m = manager
+        self._lock = manager.lock
+        self._entries: "OrderedDict[Any, StoreEntry]" = OrderedDict()
+        self._bytes = 0
+        # counters (read under the shared lock)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.hit_bytes = 0
+        self.evicted_bytes = 0
+        self.put_bytes = 0
+        self.rejected_puts = 0
+        self._known: set = set()  # keys ever stored: a miss on one of
+        # these is a recompute-after-evict, worth an event
+        manager.attach_store(self)
+
+    # -- accounting (manager reads these under the shared lock) --------------
+
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def unpinned_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values()
+                   if e.pins == 0)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    # -- the cache surface ---------------------------------------------------
+
+    def get(self, key, pin: bool = False):
+        """Return the cached batch or None. ``pin=True`` holds the
+        entry against eviction until the enclosing ``pin_scope`` exits
+        (no-op outside a scope)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                if key in self._known:
+                    metrics.record("storage", phase="miss",
+                                   key=_short(key))
+                return None
+            self._entries.move_to_end(key)
+            e.hits += 1
+            e.last_access_t = time.time()
+            self.hits += 1
+            self.hit_bytes += e.nbytes
+            if pin:
+                self._pin_locked(key, e)
+            metrics.record("storage", phase="hit", key=_short(key),
+                           bytes=e.nbytes)
+            return e.batch
+
+    def put(self, key, batch, pin: bool = False) -> bool:
+        """Insert a materialized batch; False when it cannot fit under
+        the unified budget even after evicting the store's own LRU
+        tail (the caller keeps using its batch — the entry is simply
+        not retained, and stays recomputable)."""
+        nbytes = batch_nbytes(batch)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                if pin:
+                    self._pin_locked(key, e)
+                return True
+            if not self._m.reserve_storage(nbytes):
+                self.rejected_puts += 1
+                metrics.record("storage", phase="rejected",
+                               key=_short(key), bytes=nbytes)
+                return False
+            e = StoreEntry(key, batch, nbytes)
+            self._entries[key] = e
+            self._bytes += nbytes
+            self._known.add(key)
+            self.put_bytes += nbytes
+            if pin:
+                self._pin_locked(key, e)
+            metrics.record("storage", phase="put", key=_short(key),
+                           bytes=nbytes, storage_bytes=self._bytes)
+            return True
+
+    def remove(self, key) -> int:
+        """Drop an entry regardless of LRU position (uncache); returns
+        the bytes released. Pinned entries drop from the table too —
+        the running reader keeps its reference; the accounting is
+        released because uncache is an explicit owner decision."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return 0
+            self._bytes -= e.nbytes
+            metrics.record("storage", phase="uncache", key=_short(key),
+                           bytes=e.nbytes, storage_bytes=self._bytes)
+            return e.nbytes
+
+    def unpin(self, key) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    def clear(self) -> int:
+        with self._lock:
+            freed = self._bytes
+            self._entries.clear()
+            self._bytes = 0
+            return freed
+
+    # -- eviction (called by the manager under the shared lock) --------------
+
+    def _evict_locked(self, want_bytes: int, floor: int,
+                      reason: str) -> int:
+        """Evict unpinned entries LRU-first until ``want_bytes`` are
+        freed or the store is down to ``floor`` bytes; returns freed
+        bytes. Caller holds the shared lock."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= want_bytes or self._bytes <= floor:
+                break
+            e = self._entries[key]
+            if e.pins > 0:
+                continue
+            del self._entries[key]
+            self._bytes -= e.nbytes
+            freed += e.nbytes
+            self.evictions += 1
+            self.evicted_bytes += e.nbytes
+            if reason == "execution":
+                self._m.evicted_for_execution += 1
+            metrics.record("storage", phase="evict", key=_short(key),
+                           bytes=e.nbytes, reason=reason,
+                           storage_bytes=self._bytes)
+        return freed
+
+    def _pin_locked(self, key, e: StoreEntry) -> None:
+        pins = _PINS.get()
+        if pins is None:
+            return  # no execution scope: serve unpinned
+        e.pins += 1
+        pins.append((self, key))
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes_used": self._bytes,
+                "pinned_entries": sum(
+                    1 for e in self._entries.values() if e.pins),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_bytes": self.hit_bytes,
+                "evictions": self.evictions,
+                "evicted_bytes": self.evicted_bytes,
+                "put_bytes": self.put_bytes,
+                "rejected_puts": self.rejected_puts,
+            }
+
+    def entries_snapshot(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Newest-access-last entry listing for the UI."""
+        with self._lock:
+            return [{
+                "key": _short(e.key),
+                "bytes": e.nbytes,
+                "pins": e.pins,
+                "hits": e.hits,
+                "age_s": round(time.time() - e.created_t, 1),
+            } for e in list(self._entries.values())[-n:]]
+
+
+def _short(key) -> str:
+    s = str(key)
+    return s if len(s) <= 120 else s[:117] + "..."

@@ -1,0 +1,183 @@
+"""Unified storage/execution HBM accounting.
+
+The analogue of the reference's UnifiedMemoryManager (reference:
+core/src/main/scala/org/apache/spark/memory/UnifiedMemoryManager.scala:56):
+storage (cached device batches, spark_tpu/storage/store.py) and
+execution (the scheduler's HBM admission grants,
+spark_tpu/scheduler/admission.py) share ONE byte budget —
+``spark.tpu.scheduler.hbmBudgetBytes`` — instead of each layer keeping
+its own optimistic count.
+
+Borrowing rules, mirroring the reference's asymmetric split:
+
+- execution may EVICT unpinned storage entries (LRU) to make room,
+  but never below ``spark.tpu.storage.minBytes`` — the protected
+  storage region (the reference's ``spark.memory.storageFraction``
+  floor);
+- storage may grow into memory execution is not using, but can never
+  evict a running query's grant — a cache insert that does not fit
+  after evicting storage's own LRU tail is simply rejected (the entry
+  stays recomputable, nothing blocks);
+- the idle-device progress rule of admission control is preserved:
+  with no query admitted, execution always gets a grant (capped at
+  whatever the budget minus surviving storage bytes allows — possibly
+  zero, in which case the query runs ungated and relies on the OOM
+  degradation ladder), so storage can delay but never deadlock the
+  device.
+
+Invariant (held under one lock, asserted by the eviction stress test):
+``storage_bytes + execution_in_use <= budget`` at every instant.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from spark_tpu import conf as CF
+
+
+class UnifiedMemoryManager:
+    """Shared HBM byte-budget ledger. Construct with a static budget
+    (standalone schedulers, tests) or with a ``conf`` whose
+    ``spark.tpu.scheduler.hbmBudgetBytes`` / ``spark.tpu.storage.*``
+    keys are read LIVE — a session can resize the budget between
+    queries without rebuilding the session."""
+
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 conf=None, min_storage_bytes: Optional[int] = None,
+                 max_storage_bytes: Optional[int] = None):
+        if budget_bytes is None and conf is None:
+            raise ValueError("need budget_bytes or conf")
+        self._conf = conf
+        self._budget = int(budget_bytes) if budget_bytes is not None \
+            else None
+        self._min_storage = min_storage_bytes
+        self._max_storage = max_storage_bytes
+        #: one lock for BOTH sides; the store shares it so an eviction
+        #: decision and the byte accounting it is based on are atomic
+        self.lock = threading.RLock()
+        self._execution = 0
+        self._admitted = 0
+        self._store = None  # MemoryStore registers itself
+        self.evicted_for_execution = 0  # entries evicted to admit queries
+
+    # -- live-conf properties ------------------------------------------------
+
+    @property
+    def budget(self) -> int:
+        if self._budget is not None:
+            return max(1, self._budget)
+        return max(1, int(self._conf.get(CF.SCHEDULER_HBM_BUDGET)))
+
+    @property
+    def min_storage(self) -> int:
+        if self._min_storage is not None:
+            return max(0, int(self._min_storage))
+        if self._conf is not None:
+            return max(0, int(self._conf.get(CF.STORAGE_MIN_BYTES)))
+        return 0
+
+    @property
+    def max_storage(self) -> int:
+        cap = self.budget
+        if self._max_storage is not None:
+            return min(cap, max(0, int(self._max_storage)))
+        if self._conf is not None:
+            return min(cap, max(0, int(self._conf.get(
+                CF.STORAGE_MAX_BYTES))))
+        return cap
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        self._store = store
+
+    def storage_bytes(self) -> int:
+        return self._store.bytes_used() if self._store is not None else 0
+
+    # -- execution side (the scheduler's admission gate) ---------------------
+
+    def charge_for(self, nbytes: int) -> int:
+        """What an admission of ``nbytes`` may cost at most: capped at
+        the whole budget so an over-budget query can still admit alone."""
+        return min(max(1, int(nbytes)), self.budget)
+
+    def fits_execution(self, nbytes: int) -> bool:
+        with self.lock:
+            if self._admitted == 0:
+                return True  # idle device: always make progress
+            charge = self.charge_for(nbytes)
+            avail = self.budget - self._execution - self.storage_bytes()
+            if charge <= avail:
+                return True
+            return charge <= avail + self._storage_freeable_locked()
+
+    def acquire_execution(self, nbytes: int) -> int:
+        """Charge the budget, evicting unpinned storage (LRU, down to
+        the protected ``min_storage`` region) when the free span is
+        short. Returns the actual charge for ``release_execution`` —
+        capped so the invariant holds even when protected/pinned
+        storage keeps the full request from fitting (the idle-progress
+        case; the grant may then be 0 and the query runs ungated)."""
+        with self.lock:
+            charge = self.charge_for(nbytes)
+            avail = self.budget - self._execution - self.storage_bytes()
+            if charge > avail and self._store is not None:
+                self._store._evict_locked(
+                    charge - avail, floor=self.min_storage,
+                    reason="execution")
+                avail = self.budget - self._execution \
+                    - self.storage_bytes()
+            charge = max(0, min(charge, avail))
+            self._execution += charge
+            self._admitted += 1
+            return charge
+
+    def release_execution(self, charge: int) -> None:
+        with self.lock:
+            self._execution = max(0, self._execution - int(charge))
+            self._admitted = max(0, self._admitted - 1)
+
+    def _storage_freeable_locked(self) -> int:
+        """Unpinned storage bytes execution could reclaim without
+        dipping into the protected region."""
+        if self._store is None:
+            return 0
+        unpinned = self._store.unpinned_bytes()
+        return max(0, min(unpinned,
+                          self.storage_bytes() - self.min_storage))
+
+    # -- storage side --------------------------------------------------------
+
+    def reserve_storage(self, nbytes: int) -> bool:
+        """May the store take ``nbytes`` more? Evicts the store's own
+        LRU tail to fit under ``min(max_storage, budget - execution)``;
+        never touches execution grants. Caller (the store) inserts the
+        entry under the same lock on True."""
+        with self.lock:
+            nbytes = int(nbytes)
+            limit = min(self.max_storage, self.budget - self._execution)
+            if nbytes > limit:
+                return False
+            used = self.storage_bytes()
+            if used + nbytes > limit and self._store is not None:
+                self._store._evict_locked(
+                    used + nbytes - limit, floor=0, reason="storage")
+                used = self.storage_bytes()
+            return used + nbytes <= limit
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "budget_bytes": self.budget,
+                "in_use_bytes": self._execution,
+                "admitted": self._admitted,
+                "storage_bytes": self.storage_bytes(),
+                "storage_min_bytes": self.min_storage,
+                "storage_max_bytes": self.max_storage,
+                "free_bytes": max(0, self.budget - self._execution
+                                  - self.storage_bytes()),
+            }

@@ -223,6 +223,105 @@ def format_scheduler_profile(
     return "\n".join(lines)
 
 
+def storage_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up HBM-resident storage events (spark_tpu/storage/) into
+    per-phase totals {hit|miss|put|evict|rejected|uncache: {count,
+    bytes}}, plus the live store/occupancy numbers of the active
+    session ({'store': MemoryStore.stats(), 'memory':
+    UnifiedMemoryManager.snapshot()} — storage vs execution occupancy
+    under the shared hbmBudgetBytes)."""
+    evs = events if events is not None else metrics.recent(4096)
+    out: Dict[str, dict] = {}
+    for e in evs:
+        if e.get("kind") != "storage":
+            continue
+        phase = e.get("phase", "?")
+        rec = out.setdefault(phase, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += int(e.get("bytes", 0))
+    from spark_tpu.api.session import SparkSession
+
+    sess = SparkSession.getActiveSession()
+    store = getattr(sess, "memory_store", None) if sess else None
+    if store is not None:
+        out["store"] = store.stats()
+        out["memory"] = sess.memory_manager.snapshot()
+    return out
+
+
+def format_storage_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else storage_profile()
+    phases = {k: v for k, v in p.items() if k not in ("store", "memory")}
+    if not phases and "store" not in p:
+        return "(no storage events recorded)"
+    lines = []
+    for phase in ("hit", "miss", "put", "evict", "rejected", "uncache"):
+        if phase in phases:
+            rec = phases[phase]
+            lines.append(f"{phase:<9} count={rec['count']:<6} "
+                         f"bytes={rec['bytes']}")
+    mem = p.get("memory")
+    if mem:
+        lines.append(
+            f"occupancy: storage={mem['storage_bytes']} "
+            f"execution={mem['in_use_bytes']} "
+            f"free={mem['free_bytes']} / budget={mem['budget_bytes']}")
+    st = p.get("store")
+    if st:
+        lines.append(
+            f"store: entries={st['entries']} bytes={st['bytes_used']} "
+            f"hits={st['hits']} misses={st['misses']} "
+            f"evictions={st['evictions']} rejected={st['rejected_puts']}")
+    return "\n".join(lines) if lines else "(no storage events recorded)"
+
+
+def warmup_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Where did warmup time go? Splits first-run cost into its three
+    host-side sinks — XLA trace/compile (stage_compile events, now
+    carrying ms), parquet decode, and host->device transfer (scan
+    events) — plus the persistent compilation-cache hit/miss counters,
+    which say whether 'compile' meant a fresh XLA compile or an AOT
+    load from disk."""
+    evs = events if events is not None else metrics.recent(4096)
+    out = {
+        "compile": {"count": 0, "total_ms": 0.0},
+        "decode": {"count": 0, "total_ms": 0.0},
+        "transfer": {"count": 0, "total_ms": 0.0},
+    }
+    for e in evs:
+        kind = e.get("kind")
+        if kind == "stage_compile":
+            out["compile"]["count"] += 1
+            out["compile"]["total_ms"] = round(
+                out["compile"]["total_ms"] + float(e.get("ms", 0.0)), 3)
+        elif kind == "scan":
+            out["decode"]["count"] += 1
+            out["decode"]["total_ms"] = round(
+                out["decode"]["total_ms"]
+                + float(e.get("decode_ms", 0.0)), 3)
+            out["transfer"]["count"] += 1
+            out["transfer"]["total_ms"] = round(
+                out["transfer"]["total_ms"]
+                + float(e.get("transfer_ms", 0.0)), 3)
+    out["compile_cache"] = metrics.compile_cache_stats()
+    return out
+
+
+def format_warmup_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else warmup_profile()
+    cc = p.get("compile_cache", {})
+    lines = [
+        f"trace/compile: {p['compile']['count']} stages, "
+        f"{p['compile']['total_ms']:.1f}ms",
+        f"parquet decode: {p['decode']['count']} scans, "
+        f"{p['decode']['total_ms']:.1f}ms",
+        f"host->device transfer: {p['transfer']['total_ms']:.1f}ms",
+        f"persistent compile cache: {cc.get('hits', 0)} hits / "
+        f"{cc.get('misses', 0)} misses",
+    ]
+    return "\n".join(lines)
+
+
 class PlanningTracker:
     """Phase timing for the planning pipeline (reference:
     catalyst/QueryPlanningTracker.scala). Use as

@@ -10,6 +10,7 @@ Endpoints:
   /api/v1/queries       per-query rollups (JSON)
   /api/v1/events?n=200  recent raw events (JSON)
   /api/v1/status        app name, event count, active query
+  /api/v1/storage       HBM store occupancy, counters, entry listing
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -54,6 +55,22 @@ def _scheduler_status(session) -> Optional[dict]:
         return None
 
 
+def _storage_status(session) -> Optional[dict]:
+    """HBM-resident store occupancy: storage vs execution bytes under
+    the unified budget, hit/miss/evict counters, jit-cache gauges."""
+    store = getattr(session, "memory_store", None)
+    if store is None:
+        return None
+    try:
+        return {
+            "store": store.stats(),
+            "memory": session.memory_manager.snapshot(),
+            "gauges": metrics.gauges(),
+        }
+    except Exception:
+        return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "spark-tpu-ui/1"
 
@@ -92,6 +109,21 @@ class _Handler(BaseHTTPRequestHandler):
                         for p in sched["pools"]) + "</pre>")
                 html = html.replace("</body>", block + "</body>") \
                     if "</body>" in html else html + block
+            sto = _storage_status(
+                getattr(self.server, "spark_session", None))
+            if sto is not None:
+                st, mem = sto["store"], sto["memory"]
+                block = (
+                    "<h2>Memory (unified storage/execution)</h2><pre>"
+                    f"budget={mem['budget_bytes']} "
+                    f"storage={mem['storage_bytes']} "
+                    f"execution={mem['in_use_bytes']} "
+                    f"free={mem['free_bytes']}\n"
+                    f"store: entries={st['entries']} hits={st['hits']} "
+                    f"misses={st['misses']} evictions={st['evictions']} "
+                    f"rejected_puts={st['rejected_puts']}</pre>")
+                html = html.replace("</body>", block + "</body>") \
+                    if "</body>" in html else html + block
             self._send(200, html.encode(), "text/html; charset=utf-8")
         elif url.path == "/api/v1/queries":
             self._json(history.summarize_events(events))
@@ -111,7 +143,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "active_query": active,
                 "heartbeat": hb.status() if hb is not None else None,
                 "scheduler": _scheduler_status(session),
+                "storage": _storage_status(session),
             })
+        elif url.path == "/api/v1/storage":
+            session = getattr(self.server, "spark_session", None)
+            sto = _storage_status(session)
+            if sto is not None:
+                store = session.memory_store
+                sto["entries"] = store.entries_snapshot()
+            self._json(sto)
         else:
             self._send(404, b"not found", "text/plain")
 

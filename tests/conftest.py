@@ -67,6 +67,15 @@ def pytest_addoption(parser):
              "still covering every operator class)")
 
 
+def pytest_configure(config):
+    # registered here as well as pyproject.toml so ad-hoc invocations
+    # with -p no:cacheprovider -o addopts= never warn on the marker
+    config.addinivalue_line(
+        "markers",
+        "storage: HBM-resident columnar storage / unified memory "
+        "manager tests (spark_tpu/storage/)")
+
+
 def pytest_collection_modifyitems(config, items):
     if config.getoption("--runslow"):
         return

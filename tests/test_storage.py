@@ -1,0 +1,466 @@
+"""HBM-resident columnar storage: MemoryStore + UnifiedMemoryManager
+(spark_tpu/storage/) — byte-accounted LRU caching, pinning, unified
+storage/execution budget sharing with the scheduler's admission
+control, auto-cache promotion of hot scans, and the bounded jit stage
+caches."""
+
+import glob
+import os
+import re
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_tpu import conf as CF
+from spark_tpu import metrics
+from spark_tpu.storage import (LruDict, MemoryStore, UnifiedMemoryManager,
+                               pin_scope)
+
+pytestmark = pytest.mark.storage
+
+
+class FakeBatch:
+    """Store payload with an exact byte size (store tests need sizes,
+    not real device arrays)."""
+
+    def __init__(self, nbytes: int):
+        self._n = int(nbytes)
+
+    def device_nbytes(self) -> int:
+        return self._n
+
+
+def _mgr(budget, min_storage=0, max_storage=None):
+    return UnifiedMemoryManager(budget, min_storage_bytes=min_storage,
+                                max_storage_bytes=max_storage)
+
+
+# ---- store basics -----------------------------------------------------------
+
+
+def test_put_get_accounting():
+    m = _mgr(1000)
+    s = MemoryStore(m)
+    assert s.put("a", FakeBatch(300))
+    assert s.bytes_used() == 300
+    assert s.get("a") is not None
+    assert s.get("zzz") is None
+    st = s.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert st["hit_bytes"] == 300
+    assert m.snapshot()["storage_bytes"] == 300
+
+
+def test_storage_lru_eviction_order():
+    m = _mgr(1000)
+    s = MemoryStore(m)
+    s.put("a", FakeBatch(400))
+    s.put("b", FakeBatch(400))
+    s.get("a")  # touch: b becomes LRU
+    assert s.put("c", FakeBatch(400))
+    assert "b" not in s and "a" in s and "c" in s
+    assert s.bytes_used() <= 1000
+    assert s.stats()["evictions"] == 1
+
+
+def test_put_larger_than_budget_rejected():
+    m = _mgr(1000)
+    s = MemoryStore(m)
+    assert not s.put("huge", FakeBatch(2000))
+    assert s.bytes_used() == 0
+    assert s.stats()["rejected_puts"] == 1
+
+
+def test_max_storage_caps_below_budget():
+    m = _mgr(1000, max_storage=500)
+    s = MemoryStore(m)
+    assert s.put("a", FakeBatch(400))
+    assert s.put("b", FakeBatch(400))  # evicts a to stay under 500
+    assert "a" not in s
+    assert s.bytes_used() <= 500
+
+
+# ---- unified storage/execution budget ---------------------------------------
+
+
+def test_execution_evicts_unpinned_storage_to_floor():
+    m = _mgr(1000, min_storage=200)
+    s = MemoryStore(m)
+    s.put("a", FakeBatch(300))
+    s.put("b", FakeBatch(300))
+    charge = m.acquire_execution(500)  # needs 100 more than free span
+    assert charge == 500
+    snap = m.snapshot()
+    assert snap["in_use_bytes"] + snap["storage_bytes"] <= 1000
+    assert s.stats()["evictions"] >= 1
+    assert m.evicted_for_execution >= 1
+    m.release_execution(charge)
+
+
+def test_pinned_entries_survive_execution_pressure():
+    m = _mgr(1000, min_storage=0)
+    s = MemoryStore(m)
+    s.put("pinned", FakeBatch(600))
+    with pin_scope():
+        assert s.get("pinned", pin=True) is not None
+        charge = m.acquire_execution(900)
+        # pinned entry not evictable: grant is capped, invariant holds
+        assert "pinned" in s
+        snap = m.snapshot()
+        assert snap["in_use_bytes"] + snap["storage_bytes"] <= 1000
+        m.release_execution(charge)
+    # scope exited: pin released, execution can now reclaim it
+    charge = m.acquire_execution(900)
+    assert "pinned" not in s
+    assert charge == 900
+    m.release_execution(charge)
+
+
+def test_idle_overbudget_query_admits_even_when_storage_full():
+    m = _mgr(1000, min_storage=0)
+    s = MemoryStore(m)
+    with pin_scope():
+        s.put("k", FakeBatch(1000), pin=True)
+        assert m.fits_execution(5000)  # idle device: always progress
+        charge = m.acquire_execution(5000)
+        assert charge == 0  # nothing reclaimable: runs ungated
+        snap = m.snapshot()
+        assert snap["in_use_bytes"] + snap["storage_bytes"] <= 1000
+        m.release_execution(charge)
+
+
+def test_pin_scope_reentrant():
+    m = _mgr(1000)
+    s = MemoryStore(m)
+    s.put("k", FakeBatch(100))
+    with pin_scope():
+        s.get("k", pin=True)
+        with pin_scope():  # inner scope folds into the outer
+            s.get("k", pin=True)
+        assert s.entries_snapshot()[0]["pins"] == 2  # inner did NOT unpin
+    assert s.entries_snapshot()[0]["pins"] == 0
+
+
+# ---- scheduler integration --------------------------------------------------
+
+
+@pytest.mark.timeout(60)
+def test_eviction_racing_admission_invariant_8_clients():
+    """8 workers churn storage puts/pinned gets while the scheduler
+    admits/releases execution grants against the SAME unified budget;
+    a sampler asserts storage+execution never exceeds it."""
+    from spark_tpu.scheduler import QueryScheduler
+
+    conf = CF.RuntimeConf({
+        "spark.tpu.scheduler.hbmBudgetBytes": 10_000,
+        "spark.tpu.storage.minBytes": 1_000,
+        "spark.tpu.storage.maxBytes": 8_000,
+        "spark.tpu.scheduler.maxConcurrency": 8,
+        "spark.tpu.scheduler.queueDepth": 256,
+    })
+    sched = QueryScheduler(conf=conf)
+    m = sched.admission.manager
+    store = MemoryStore(m)
+    stop = threading.Event()
+    violations = []
+
+    def sampler():
+        while not stop.is_set():
+            snap = m.snapshot()
+            if snap["in_use_bytes"] + snap["storage_bytes"] \
+                    > snap["budget_bytes"]:
+                violations.append(snap)
+            time.sleep(0.0005)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    def make_run(i):
+        def run(t):
+            with pin_scope():
+                key = ("hot", i % 6)
+                if store.get(key, pin=True) is None:
+                    store.put(key, FakeBatch(1500), pin=True)
+                time.sleep(0.002)
+            return i
+        return run
+
+    tickets = [sched.submit(make_run(i), description=f"q{i}",
+                            est_bytes=(i % 5 + 1) * 1200)
+               for i in range(64)]
+    results = [t.result(timeout=30) for t in tickets]
+    stop.set()
+    sampler_t.join(1)
+    sched.stop()
+    assert results == list(range(64))
+    assert not violations, f"budget invariant violated: {violations[:3]}"
+
+
+def test_session_scheduler_share_manager(spark):
+    from spark_tpu.scheduler import QueryScheduler
+
+    sched = QueryScheduler(spark)
+    try:
+        assert sched.admission.manager is spark.memory_manager
+    finally:
+        sched.stop()
+
+
+# ---- session cache manager on the store -------------------------------------
+
+
+def _write_parquet(tmp_path, name, nrows=256):
+    t = pa.table({
+        "k": pa.array([i % 7 for i in range(nrows)], pa.int64()),
+        "v": pa.array([float(i) for i in range(nrows)], pa.float64()),
+    })
+    p = os.path.join(str(tmp_path), name)
+    pq.write_table(t, p)
+    return p
+
+
+def test_cache_materializes_into_store_and_uncache_releases(spark, tmp_path):
+    df = spark.read.parquet(_write_parquet(tmp_path, "t1.parquet"))
+    agg = df.groupBy("k").count()
+    before = spark.memory_store.bytes_used()
+    df.cache()
+    r1 = agg.toArrow()
+    after = spark.memory_store.bytes_used()
+    assert after > before  # cached table is device-resident in the store
+    r2 = agg.toArrow()
+    assert r2.equals(r1)
+    df.unpersist()
+    assert spark.memory_store.bytes_used() == before  # bytes released
+
+
+def test_recompute_after_evict_is_byte_identical(spark, tmp_path):
+    df = spark.read.parquet(_write_parquet(tmp_path, "t2.parquet"))
+    agg = df.groupBy("k").count()
+    df.cache()
+    try:
+        r1 = agg.toArrow()
+        misses0 = spark.memory_store.stats()["misses"]
+        # evict everything the store holds (execution-pressure analogue)
+        with spark.memory_manager.lock:
+            spark.memory_store._evict_locked(1 << 62, floor=0,
+                                             reason="execution")
+        r2 = agg.toArrow()  # recompute-after-evict: single-flight rerun
+        assert r2.equals(r1)
+        assert spark.memory_store.stats()["misses"] > misses0
+        # the recompute re-populated the store; third run hits
+        hits0 = spark.memory_store.stats()["hits"]
+        assert agg.toArrow().equals(r1)
+        assert spark.memory_store.stats()["hits"] > hits0
+    finally:
+        df.unpersist()
+
+
+@pytest.mark.timeout(120)
+def test_concurrent_cached_queries_byte_identical_under_eviction(
+        spark, tmp_path):
+    """Two cached tables that cannot BOTH fit: every read of one may
+    evict the other, so 8 client threads continuously race eviction
+    against materialization. All results must stay byte-identical."""
+    df1 = spark.read.parquet(_write_parquet(tmp_path, "e1.parquet", 512))
+    df2 = spark.read.parquet(_write_parquet(tmp_path, "e2.parquet", 512))
+    a1, a2 = df1.groupBy("k").count(), df2.groupBy("k").count()
+    df1.cache()
+    df2.cache()
+    base = spark.memory_store.bytes_used()
+    ref1 = a1.toArrow()
+    one = spark.memory_store.bytes_used() - base
+    ref2 = a2.toArrow()
+    try:
+        # room for ~1.5 entries: the second table's put evicts the first
+        spark.conf.set("spark.tpu.storage.maxBytes", max(1, int(one * 1.5)))
+        spark.conf.set("spark.tpu.storage.minBytes", 0)
+        bad, lock = [], threading.Lock()
+
+        def client(i):
+            for _ in range(6):
+                agg, ref = (a1, ref1) if i % 2 == 0 else (a2, ref2)
+                try:
+                    out = agg.toArrow()
+                    if not out.equals(ref):
+                        with lock:
+                            bad.append(f"client{i}: result mismatch")
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        bad.append(f"client{i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not bad, bad[:5]
+        snap = spark.memory_manager.snapshot()
+        assert snap["storage_bytes"] + snap["in_use_bytes"] \
+            <= snap["budget_bytes"]
+    finally:
+        spark.conf.unset("spark.tpu.storage.maxBytes")
+        spark.conf.unset("spark.tpu.storage.minBytes")
+        df1.unpersist()
+        df2.unpersist()
+
+
+def test_auto_cache_promotes_hot_scan(spark, tmp_path):
+    df = spark.read.parquet(_write_parquet(tmp_path, "hot.parquet"))
+    q = df.select("v").filter(df.v >= 0.0)
+    entries0 = len(spark.memory_store)
+    q.collect()  # read 1: below threshold (default 2)
+    q.collect()  # read 2: promoted into the store
+    assert len(spark.memory_store) > entries0
+    hits0 = spark.memory_store.stats()["hits"]
+    r = q.collect()  # read 3: served from the store
+    assert spark.memory_store.stats()["hits"] > hits0
+    assert len(r) == 256
+
+
+def test_auto_cache_disabled_by_conf(spark, tmp_path):
+    spark.conf.set("spark.tpu.storage.autoCacheThreshold", 0)
+    try:
+        df = spark.read.parquet(_write_parquet(tmp_path, "cold.parquet"))
+        q = df.select("k")
+        entries0 = len(spark.memory_store)
+        for _ in range(4):
+            q.collect()
+        assert len(spark.memory_store) == entries0
+    finally:
+        spark.conf.unset("spark.tpu.storage.autoCacheThreshold")
+
+
+# ---- bounded jit stage caches -----------------------------------------------
+
+
+def test_lru_dict_bounded_with_gauge():
+    d = LruDict("t_bound", cap=3)
+    for i in range(6):
+        d[i] = i * 10
+    assert len(d) == 3
+    assert 0 not in d and 5 in d
+    assert d.evictions == 3
+    assert metrics.gauges()["jit_cache.t_bound.entries"] == 3
+    d.get(3)  # touch
+    d[6] = 60
+    assert 3 in d and 4 not in d  # LRU, not FIFO
+
+
+def test_stage_caches_are_bounded_and_conf_driven(spark):
+    from spark_tpu.parallel import executor as EX
+    from spark_tpu.physical import planner as PL
+
+    assert isinstance(PL._STAGE_CACHE, LruDict)
+    assert isinstance(EX._DIST_STAGE_CACHE, LruDict)
+    spark.conf.set("spark.tpu.jit.stageCacheEntries", 2)
+    try:
+        d = LruDict("t_conf", cap_entry=CF.JIT_STAGE_CACHE_ENTRIES)
+        for i in range(5):
+            d[i] = i
+        assert len(d) == 2  # cap read live from the session conf
+    finally:
+        spark.conf.unset("spark.tpu.jit.stageCacheEntries")
+
+
+# ---- compile-cache counters + warmup profile --------------------------------
+
+
+def test_compile_cache_counters_and_warmup_profile():
+    from spark_tpu import tracing
+
+    before = metrics.compile_cache_stats()
+    metrics.note_compile_cache(True)
+    metrics.note_compile_cache(False)
+    after = metrics.compile_cache_stats()
+    assert after["hits"] == before["hits"] + 1
+    assert after["misses"] == before["misses"] + 1
+    prof = tracing.warmup_profile([
+        {"kind": "stage_compile", "ms": 120.0},
+        {"kind": "scan", "decode_ms": 30.0, "transfer_ms": 5.0},
+    ])
+    assert prof["compile"] == {"count": 1, "total_ms": 120.0}
+    assert prof["decode"]["total_ms"] == 30.0
+    assert prof["transfer"]["total_ms"] == 5.0
+    assert "hits" in prof["compile_cache"]
+    assert "compile" in tracing.format_warmup_profile(prof)
+
+
+def test_instrument_compile_cache_idempotent():
+    from spark_tpu.api.session import _instrument_compile_cache
+
+    _instrument_compile_cache()
+    _instrument_compile_cache()
+    try:
+        from jax._src import compilation_cache as _cc
+    except Exception:
+        return
+    fn = getattr(_cc, "get_executable_and_time", None)
+    if fn is not None:
+        assert getattr(fn, "_spark_tpu_counted", False)
+        # double-instrumenting must not stack wrappers
+        assert not getattr(getattr(fn, "__wrapped__", None),
+                           "_spark_tpu_counted", False)
+
+
+# ---- storage profile + UI ---------------------------------------------------
+
+
+def test_storage_profile_rollup(spark):
+    from spark_tpu import tracing
+
+    prof = tracing.storage_profile([
+        {"kind": "storage", "phase": "hit", "bytes": 100},
+        {"kind": "storage", "phase": "hit", "bytes": 50},
+        {"kind": "storage", "phase": "evict", "bytes": 100},
+    ])
+    assert prof["hit"] == {"count": 2, "bytes": 150}
+    assert prof["evict"] == {"count": 1, "bytes": 100}
+    assert "store" in prof and "memory" in prof  # live session numbers
+    txt = tracing.format_storage_profile(prof)
+    assert "occupancy" in txt and "hit" in txt
+
+
+def test_ui_storage_endpoint(spark):
+    import json
+    import urllib.request
+
+    from spark_tpu.ui import StatusServer
+
+    srv = StatusServer(spark, port=0)
+    try:
+        with urllib.request.urlopen(f"{srv.url}/api/v1/storage",
+                                    timeout=10) as r:
+            payload = json.loads(r.read())
+        assert set(payload) >= {"store", "memory", "entries"}
+        assert payload["memory"]["budget_bytes"] > 0
+        with urllib.request.urlopen(f"{srv.url}/api/v1/status",
+                                    timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["storage"] is not None
+    finally:
+        srv.stop()
+
+
+# ---- conf hygiene -----------------------------------------------------------
+
+
+def test_all_storage_conf_keys_declared():
+    """Every spark.tpu.storage.* key referenced anywhere in the source
+    is registered in conf.py with a default and a docstring."""
+    root = os.path.join(os.path.dirname(__file__), "..", "spark_tpu")
+    used = set()
+    for path in glob.glob(os.path.join(root, "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            used.update(re.findall(r"spark\.tpu\.storage\.\w+",
+                                   f.read()))
+    assert used, "no spark.tpu.storage.* keys found in source"
+    for key in used:
+        assert key in CF._REGISTRY, f"{key} not registered in conf.py"
+        entry = CF._REGISTRY[key]
+        assert entry.doc and len(entry.doc) > 20, f"{key} lacks a doc"
+        assert entry.default is not None, f"{key} lacks a default"
