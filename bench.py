@@ -69,6 +69,11 @@ _WALL_T0 = time.time()
 # BENCH_CACHED=0 skips the HBM-store cached-mode report
 CACHED_MODE = os.environ.get("BENCH_CACHED", "1") == "1"
 
+# BENCH_ADAPTIVE=0 skips the adaptive-execution A/B phase (off vs on
+# timing + byte-identity + padding-ratio report; needs BENCH_MASTER=
+# mesh[N] to actually engage — single-device sessions have no exchange
+# stages to re-plan and report {"skipped": ...})
+
 
 def _wall_remaining() -> float:
     if WALL_BUDGET_S <= 0:
@@ -135,6 +140,26 @@ def _robustness_counters() -> dict:
         if kind in counts:
             counts[kind] += 1
     return counts
+
+
+def _shuffle_block() -> dict:
+    """Per-query shuffle observability: exchange count, rows actually
+    sent over ICI, buffer bytes, padding ratio (dead slots the static
+    capacity contract shipped anyway), and any adaptive decisions —
+    for the execution that just finished (metrics.last_query)."""
+    from spark_tpu import metrics, tracing
+
+    try:
+        prof = tracing.exchange_profile(metrics.last_query())
+    except Exception:
+        return {}
+    return {
+        "exchanges": prof["exchanges"],
+        "rows_sent": prof["rows_sent"],
+        "buffer_bytes": prof["buffer_bytes"],
+        "padding_ratio": prof["padding_ratio"],
+        "aqe": prof["decisions"],
+    }
 
 
 def _query_bytes(plan, conf) -> int:
@@ -275,7 +300,13 @@ def main():
     from spark_tpu.tpch.queries import QUERIES
 
     platform = jax.devices()[0].platform
-    spark = SparkSession.builder.getOrCreate()
+    builder = SparkSession.builder
+    # BENCH_MASTER=mesh[N] runs the whole benchmark distributed (and
+    # makes the adaptive A/B phase meaningful — it needs exchanges)
+    master = os.environ.get("BENCH_MASTER", "")
+    if master:
+        builder = builder.master(master)
+    spark = builder.getOrCreate()
 
     t0 = time.time()
     tmp = ensure_dataset(SF)  # generate-once disk cache
@@ -366,6 +397,25 @@ def main():
                    "cached": cached,
                    "robustness": _robustness_counters()})
 
+    adaptive = None
+    if os.environ.get("BENCH_ADAPTIVE", "1") == "1":
+        if _wall_remaining() <= 5:
+            adaptive = {"error": "skipped: wall budget exhausted"}
+        else:
+            print("[bench] adaptive A/B: spark.tpu.adaptive.enabled "
+                  "off vs on", file=sys.stderr, flush=True)
+            try:
+                with _deadline(_query_deadline()):
+                    adaptive = _run_adaptive_compare(spark)
+            except _QueryTimeout:
+                adaptive = {"error": "timeout"}
+            except Exception as e:
+                adaptive = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "adaptive": adaptive,
+                   "robustness": _robustness_counters()})
+
     serving = None
     if args.concurrency > 0:
         if _wall_remaining() <= 5:
@@ -381,6 +431,10 @@ def main():
                         rounds=args.serving_rounds)
             except Exception as e:
                 serving = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "serving": serving,
+                   "robustness": _robustness_counters()})
 
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
@@ -389,7 +443,7 @@ def main():
     total_ms = sum(r["ms"] for r in ok.values())
     vs = (sum(BASELINE_MS[q] for q in ok) * SF / total_ms
           if total_ms else 0.0)
-    print(json.dumps({
+    final = {
         "metric": f"tpch_sf{SF:g}_q1q3q5_total",
         "value": round(total_ms, 1),
         "unit": "ms",
@@ -407,10 +461,16 @@ def main():
         "wall_used_s": round(time.time() - _WALL_T0, 1),
         "queries": {str(k): v for k, v in results.items()},
         **({"cached": cached} if cached is not None else {}),
+        **({"adaptive": adaptive} if adaptive is not None else {}),
         **({"serving": serving} if serving is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}
            if full else {}),
-    }))
+    }
+    # the complete document also lands at PARTIAL_PATH: a driver that
+    # kills the process between here and stdout flush (rc=124 with
+    # parsed:null) still finds every completed result on disk
+    _snapshot(final)
+    print(json.dumps(final))
 
 
 def _run_cached(spark, qnums, rounds: int = 3) -> dict:
@@ -454,6 +514,60 @@ def _run_cached(spark, qnums, rounds: int = 3) -> dict:
     out["store"] = spark.memory_store.stats()
     out["memory"] = spark.memory_manager.snapshot()
     return {str(k): v for k, v in out.items()}
+
+
+def _run_adaptive_compare(spark) -> dict:
+    """Adaptive-vs-static A/B over the distributed engine: the two
+    exchange-heavy shapes AQE targets (distributed group-by, join +
+    group-by), timed with ``spark.tpu.adaptive.enabled`` off then on.
+    Results must be byte-identical — a faster wrong answer is not a
+    result — and the padding ratio (dead slots shipped over ICI) should
+    drop under adaptive capacity compaction. Skipped on single-device
+    sessions, where no exchange stage exists to re-plan (run with
+    BENCH_MASTER=mesh[N] to engage)."""
+    from spark_tpu import metrics
+
+    if getattr(spark, "_mesh", None) is None:
+        return {"skipped": "single-device session (no mesh): no "
+                           "exchange stages to re-plan"}
+    queries = {
+        "groupby": "SELECT l_suppkey, sum(l_quantity) AS s, "
+                   "count(*) AS c FROM lineitem GROUP BY l_suppkey "
+                   "ORDER BY l_suppkey",
+        "join_groupby": "SELECT c_custkey, count(*) AS cnt "
+                        "FROM customer, orders "
+                        "WHERE c_custkey = o_custkey "
+                        "GROUP BY c_custkey ORDER BY c_custkey",
+    }
+    out = {}
+    conf = spark.conf
+    try:
+        for name, sql in queries.items():
+            df = spark.sql(sql)
+
+            def timed(adaptive):
+                conf.set("spark.tpu.adaptive.enabled", adaptive)
+                ref = df.toArrow()  # warm-up: compile off the clock
+                t0 = time.perf_counter()
+                got = df.toArrow()
+                ms = (time.perf_counter() - t0) * 1000.0
+                return ref, got, round(ms, 1), _shuffle_block()
+
+            _, off_tbl, off_ms, off_sh = timed(False)
+            _, on_tbl, on_ms, on_sh = timed(True)
+            out[name] = {
+                "off_ms": off_ms,
+                "on_ms": on_ms,
+                "byte_identical": bool(on_tbl.equals(off_tbl)),
+                "padding_ratio_off": off_sh.get("padding_ratio"),
+                "padding_ratio_on": on_sh.get("padding_ratio"),
+                "buffer_bytes_off": off_sh.get("buffer_bytes"),
+                "buffer_bytes_on": on_sh.get("buffer_bytes"),
+                "aqe": on_sh.get("aqe", []),
+            }
+    finally:
+        conf.unset("spark.tpu.adaptive.enabled")
+    return out
 
 
 def _run_headline(spark, qnum: int) -> dict:
@@ -520,6 +634,7 @@ def _run_headline(spark, qnum: int) -> dict:
         "scan_gb": round(nbytes / 1e9, 3),
         "implied_gbps": round(gbps, 1),
         "vs_spark_cpu_est": round(BASELINE_MS[qnum] * SF / ms, 2),
+        "shuffle": _shuffle_block(),
     }
 
 

@@ -322,10 +322,30 @@ class DataFrame:
                 plan = self._session.cache_manager.apply(plan, run_full)
                 # lineage recompute on transient environment failure
                 # (reference: DAGScheduler.scala:1762 stage resubmission)
-                return run_stage_with_recovery(
+                out = run_stage_with_recovery(
                     lambda: run_full(plan), conf=self._session.conf,
                     label=type(self._plan).__name__)
+                self._note_measured_bytes()
+                return out
         return run_full(plan)
+
+    def _note_measured_bytes(self) -> None:
+        """Feed scheduler admission with the measured peak stage
+        footprint of this query (the max stage_bytes event the mesh
+        executor recorded since query_start), keyed by the RAW logical
+        plan — the same plan shape scheduler.submit_query estimates
+        before execution, so the next admission of this query uses
+        measured, not static, bytes."""
+        try:
+            from spark_tpu import metrics
+            from spark_tpu.scheduler import admission
+
+            peak = max((int(e.get("bytes", 0))
+                        for e in metrics.last_query()
+                        if e.get("kind") == "stage_bytes"), default=0)
+            admission.note_measured_bytes(self._plan, peak)
+        except Exception:
+            pass  # observability must never fail the query
 
     def collect(self) -> List[Row]:
         batch = self._execute()

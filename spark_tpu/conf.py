@@ -194,6 +194,62 @@ JIT_STAGE_CACHE_ENTRIES = register(
     "next use. Live sizes are published as metrics gauges "
     "jit_cache.<fused|dist>.entries.", int)
 
+# ---- adaptive query execution over the mesh (AQE) --------------------------
+
+ADAPTIVE_ENABLED = register(
+    "spark.tpu.adaptive.enabled", False,
+    "Adaptive query execution over the ICI mesh (reference: "
+    "spark.sql.adaptive.enabled / AdaptiveSparkPlanExec.scala:98): split "
+    "the fused SPMD program at exchange boundaries, measure per-device "
+    "live counts with one psum/pmax stats stage, then re-trace the "
+    "consumer at a compacted bucket-rounded capacity, switch measured-"
+    "small join builds to broadcast, and fan skewed destinations over "
+    "the partial->final aggregate merge. Results are byte-identical on "
+    "or off; the OOM-degradation ladder also retries a failed run with "
+    "this forced on before falling back to chunking.", bool)
+
+ADAPTIVE_BROADCAST_THRESHOLD = register(
+    "spark.tpu.adaptive.autoBroadcastJoinThreshold", 8 * 1024 * 1024,
+    "Max MEASURED build-side bytes (live rows x row width, counted on "
+    "device, not the static capacity estimate) for runtime broadcast-"
+    "join switching when adaptive execution is on (reference: "
+    "DynamicJoinSelection.scala:40 over MapOutputStatistics).", int)
+
+ADAPTIVE_CAPACITY_BUCKET = register(
+    "spark.tpu.adaptive.capacityBucket", 1024,
+    "Post-exchange capacities are the measured pmax live count rounded "
+    "UP to a multiple of this, so adaptive re-traces of the consumer "
+    "stage land on a small set of capacities and hit the jit stage "
+    "cache instead of recompiling per exact row count (reference "
+    "analogue: spark.sql.adaptive.coalescePartitions.*).", int)
+
+ADAPTIVE_SKEW_FACTOR = register(
+    "spark.tpu.adaptive.skewedPartitionFactor", 4,
+    "A hash-exchange destination whose measured incoming live count "
+    "exceeds this many times the median destination's is skewed: its "
+    "rows stay on their source device (a local-shuffle-reader fan), get "
+    "pre-merged by the partial aggregate, and only the merged groups "
+    "re-exchange (reference: OptimizeSkewedJoin.scala "
+    "SKEW_JOIN_SKEWED_PARTITION_FACTOR). Only taken when every "
+    "aggregate merge is exactly re-applicable (int sum/count/min/max), "
+    "so results stay byte-identical.", int)
+
+ADAPTIVE_SKEW_MIN_ROWS = register(
+    "spark.tpu.adaptive.skewMinRows", 4096,
+    "Absolute floor for the skew fan: the hottest destination must "
+    "expect at least this many incoming rows (the factor alone "
+    "misfires on tiny exchanges where one extra row looks like 'skew' "
+    "— same reason the reference pairs its factor with "
+    "SKEW_JOIN_SKEWED_PARTITION_THRESHOLD).", int)
+
+SEARCHSORTED_SORT_THRESHOLD = register(
+    "spark.tpu.kernels.searchsortedSortThreshold", 50,
+    "physical/kernels.searchsorted picks XLA's O((n+m)log(n+m)) "
+    "method='sort' over the O(n*log m) per-row scan when the queries "
+    "are large (>= 4096) AND queries*THIS > haystack size; raise it to "
+    "prefer sort (wide all-to-all style lookups), lower it toward 0 to "
+    "prefer scan (few queries against huge sorted runs).", int)
+
 
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""

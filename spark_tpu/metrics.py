@@ -97,6 +97,40 @@ def reset() -> None:
         _QUERY_MARKS.clear()
 
 
+def record_exchange(op: str, *, mode: str, devices: int, rows: int,
+                    capacity_before: int, capacity_after: int,
+                    buffer_bytes: int, exchanges: int = 1,
+                    slice_capacity: Optional[int] = None) -> None:
+    """One exchange observation (parallel/executor records these):
+    ``mode`` is "adaptive" (a cut stage that ran under measured bounds)
+    or "fused" (exchanges ran inside a fused stage at the static
+    worst-case capacity — capacities then describe the stage output).
+    ``capacity_*`` are PER-DEVICE capacities before/after adaptive
+    compaction; ``buffer_bytes`` is the (D, slice) all_to_all send
+    tensor a device ships over ICI; ``rows`` is global live rows
+    through the exchange. The derived live-row fraction / padding
+    ratio and the raw fields also land in gauges (exchange.*) for the
+    ui /api/v1/exchange endpoint."""
+    slots = max(1, int(capacity_after) * int(devices))
+    live_fraction = min(1.0, int(rows) / slots)
+    padding_ratio = round(1.0 - live_fraction, 4)
+    fields: Dict[str, Any] = dict(
+        op=op, mode=mode, devices=int(devices), rows=int(rows),
+        exchanges=int(exchanges),
+        capacity_before=int(capacity_before),
+        capacity_after=int(capacity_after),
+        buffer_bytes=int(buffer_bytes),
+        live_fraction=round(live_fraction, 4),
+        padding_ratio=padding_ratio)
+    if slice_capacity is not None:
+        fields["slice_capacity"] = int(slice_capacity)
+    record("exchange", **fields)
+    for k in ("rows", "buffer_bytes", "padding_ratio", "live_fraction",
+              "capacity_before", "capacity_after"):
+        set_gauge(f"exchange.{k}", fields[k])
+    set_gauge("exchange.mode", mode)
+
+
 # ---- gauges -----------------------------------------------------------------
 
 #: last-set values for point-in-time measures (cache sizes, occupancy)

@@ -97,14 +97,42 @@ def range_target(key: TV, ascending: bool, nulls_first: bool, d: int,
     return jnp.searchsorted(splitters, y, side="right").astype(jnp.int32)
 
 
+def fan_local(target: jnp.ndarray,
+              hot: Sequence[int]) -> jnp.ndarray:
+    """Skew fan: rows bound for a hot destination stay on their source
+    device instead (the local-shuffle-reader move, reference:
+    OptimizeShuffleWithLocalShuffleReader.scala:35 — a skewed partition
+    is read where it was produced rather than concentrated). Every
+    device holds a slice of the hot keys afterwards; a partial-aggregate
+    pre-merge plus a second exchange of the (much smaller) merged groups
+    restores the final placement."""
+    me = axis_index()
+    hot_mask = jnp.zeros(target.shape, dtype=bool)
+    for h in hot:
+        hot_mask = hot_mask | (target == int(h))
+    return jnp.where(hot_mask, me.astype(target.dtype), target)
+
+
 # ---- the collective exchange ------------------------------------------------
 
 
-def exchange(pipe: Pipe, target: jnp.ndarray) -> Pipe:
+def exchange(pipe: Pipe, target: jnp.ndarray,
+             slice_capacity: Optional[int] = None,
+             out_capacity: Optional[int] = None) -> Pipe:
     """Route each live row to device ``target[row]``. Local capacity cap
     becomes D*cap after the all_to_all. One fused sequence:
     sort-by-destination -> scatter into (D, cap) send buffer ->
-    all_to_all over ICI -> flatten."""
+    all_to_all over ICI -> flatten.
+
+    Adaptive execution (executor._run_adaptive_exchange) passes measured
+    bounds: ``slice_capacity`` shrinks the per-(src,dest) send slice
+    from cap to the measured pmax cell count (the all_to_all then moves
+    D*slice instead of D*cap elements over ICI), and ``out_capacity``
+    compacts the received rows in-trace to the measured pmax incoming
+    count. Both are exact upper bounds from the same target computation,
+    so no live row is ever dropped, and both transforms are stable
+    (order-preserving), so the live-row sequence — and therefore every
+    downstream result — is byte-identical to the unbounded exchange."""
     # fault seam: fires at trace time (a failed trace is never cached,
     # so a stage retry re-traces and re-arrives here)
     from spark_tpu import faults
@@ -112,19 +140,25 @@ def exchange(pipe: Pipe, target: jnp.ndarray) -> Pipe:
     faults.inject("exchange.all_to_all")
     d = axis_size()
     cap = pipe.capacity
+    scap = cap if slice_capacity is None else max(1, min(int(slice_capacity),
+                                                         cap))
     live = pipe.mask
     t = jnp.where(live, jnp.clip(target, 0, d - 1), d)  # dead rows -> sentinel
     order = jnp.argsort(t, stable=True)
     st = t[order]
     starts = jnp.searchsorted(st, jnp.arange(d), side="left")
     pos = jnp.arange(cap) - starts[jnp.clip(st, 0, d - 1)]
-    # destination slot in the (D, cap) buffer; sentinel rows -> OOB drop
-    dest = jnp.where(st < d, st * cap + pos, d * cap)
+    # destination slot in the (D, scap) buffer; sentinel rows -> OOB drop
+    # (pos >= scap cannot happen for live rows when slice_capacity is a
+    # measured bound, but the guard keeps a stale bound safe: overflow
+    # drops rather than corrupting a neighbour slice)
+    ok = (st < d) & (pos < scap)
+    dest = jnp.where(ok, st * scap + pos, d * scap)
 
     def route(x: jnp.ndarray, fill) -> jnp.ndarray:
-        buf = jnp.full((d * cap,), fill, dtype=x.dtype)
+        buf = jnp.full((d * scap,), fill, dtype=x.dtype)
         buf = buf.at[dest].set(x[order], mode="drop")
-        return jax.lax.all_to_all(buf.reshape(d, cap), DATA_AXIS, 0, 0,
+        return jax.lax.all_to_all(buf.reshape(d, scap), DATA_AXIS, 0, 0,
                                   tiled=True).reshape(-1)
 
     new_mask = route(live, False)
@@ -134,7 +168,24 @@ def exchange(pipe: Pipe, target: jnp.ndarray) -> Pipe:
         data = route(tv.data, jnp.zeros((), tv.data.dtype))
         validity = None if tv.validity is None else route(tv.validity, False)
         cols[name] = TV(data, validity, tv.dtype, tv.dictionary)
-    return Pipe(cols, new_mask, pipe.order)
+    out = Pipe(cols, new_mask, pipe.order)
+    if out_capacity is not None and int(out_capacity) < d * scap:
+        out = compact(out, int(out_capacity))
+    return out
+
+
+def compact(pipe: Pipe, new_capacity: int) -> Pipe:
+    """Stable in-trace compaction: live rows to the front (original
+    order preserved), then truncate to ``new_capacity`` slots. The bound
+    must cover every live row (adaptive stats guarantee it)."""
+    perm = K.compaction_permutation(pipe.mask)[: int(new_capacity)]
+    cols = {
+        name: TV(tv.data[perm],
+                 None if tv.validity is None else tv.validity[perm],
+                 tv.dtype, tv.dictionary)
+        for name, tv in pipe.cols.items()
+    }
+    return Pipe(cols, pipe.mask[perm], pipe.order)
 
 
 def broadcast_gather(pipe: Pipe) -> Pipe:

@@ -17,6 +17,7 @@ capacity, then the join stage runs with static shapes.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import math
 from dataclasses import dataclass
@@ -47,6 +48,74 @@ _SPEC = PartitionSpec(DATA_AXIS)
 from spark_tpu.storage.lru import LruDict  # noqa: E402
 
 _DIST_STAGE_CACHE = LruDict("dist", CF.JIT_STAGE_CACHE_ENTRIES)
+
+#: OOM-degradation override (recovery.py): a run that OOMed with
+#: adaptive execution off retries once with it forced on — measured
+#: post-exchange compaction is the cheapest rung of the ladder, ahead
+#: of chunked re-planning. Contextvar, not conf: the retry must not
+#: leak into concurrently scheduled queries sharing the session conf.
+FORCE_ADAPTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "spark_tpu_force_adaptive", default=False)
+
+#: exchange kinds the AQE pass cuts into separate stages (broadcast /
+#: single-partition exchanges use the all_gather data plane — there is
+#: no (D, cap) routing buffer to shrink, so they stay fused)
+_ADAPTIVE_EXCHANGES = (D.HashPartitionExchangeExec,
+                       D.RoundRobinExchangeExec,
+                       D.RangeExchangeExec)
+
+
+def _exchange_op(ex: P.PhysicalPlan) -> str:
+    if isinstance(ex, D.HashPartitionExchangeExec):
+        return "hash"
+    if isinstance(ex, D.RangeExchangeExec):
+        return "range"
+    if isinstance(ex, D.RoundRobinExchangeExec):
+        return "roundrobin"
+    return type(ex).__name__
+
+
+def _count_exchange_nodes(plan: P.PhysicalPlan) -> int:
+    n = int(isinstance(plan, _ADAPTIVE_EXCHANGES + (
+        D.BroadcastExchangeExec, D.SinglePartitionExchangeExec)))
+    return n + sum(_count_exchange_nodes(c) for c in plan.children())
+
+
+def _exactly_remergeable(consumer: "D.DistSortAggExec",
+                         schema: Schema) -> bool:
+    """True when the consumer's aggregate list can be re-applied to its
+    own output byte-identically — the precondition for the skew fan's
+    pre-merge. AggSpec merges are structurally idempotent (merge
+    aliases == accumulator names), so the question is purely numeric:
+    integer Sum is associative under wraparound, Min/Max over
+    non-floats is order-free. Float Sum (rounding), float Min/Max
+    (-0.0/NaN select order), and anything else stays on the exact
+    single-merge path."""
+    by_name = {f.name: f for f in schema.fields}
+    from spark_tpu.expr.compiler import _jnp_dtype
+
+    for a in consumer.aggregates:
+        e = E.strip_alias(a)
+        if isinstance(e, E.Col):  # group key carried through
+            continue
+        if not isinstance(e, (E.Sum, E.Min, E.Max)):
+            return False
+        kids = e.children()
+        if len(kids) != 1 or not isinstance(kids[0], E.Col):
+            return False
+        f = by_name.get(kids[0].name)
+        if f is None:
+            return False
+        try:
+            dt = np.dtype(_jnp_dtype(f.dtype))
+        except Exception:
+            return False
+        if isinstance(e, E.Sum):
+            if not (np.issubdtype(dt, np.integer) or dt == np.bool_):
+                return False
+        elif np.issubdtype(dt, np.floating):
+            return False
+    return True
 
 
 @dataclass(eq=False)
@@ -124,21 +193,26 @@ class _CompactExec(P.PhysicalPlan):
         return ("Compact", self.new_capacity, self.child.plan_key())
 
 
-def _estimated_bytes(sb) -> int:
-    """Estimated device bytes of a join build side: total capacity x
-    per-row width from the schema (the size estimate the reference takes
-    from plan statistics, SizeInBytesOnlyStatsPlanVisitor)."""
+def _row_width(schema: Schema) -> int:
+    """Device bytes per row (data + validity) from the schema."""
     from spark_tpu.expr.compiler import _jnp_dtype
 
     width = 0
-    for f in sb.schema.fields:
+    for f in schema.fields:
         try:
             width += np.dtype(_jnp_dtype(f.dtype)).itemsize
         except Exception:
             width += 8
         if f.nullable:
             width += 1
-    return int(sb.capacity) * width
+    return width
+
+
+def _estimated_bytes(sb) -> int:
+    """Estimated device bytes of a join build side: total capacity x
+    per-row width from the schema (the size estimate the reference takes
+    from plan statistics, SizeInBytesOnlyStatsPlanVisitor)."""
+    return int(sb.capacity) * _row_width(sb.schema)
 
 
 def _decode_key_value(raw, field):
@@ -405,6 +479,8 @@ class MeshExecutor:
 
     def run(self, plan: P.PhysicalPlan) -> ShardedBatch:
         plan = self._materialize_boundaries(plan)
+        if self._adaptive_enabled():
+            plan = self._materialize_exchanges(plan)
         if isinstance(plan, D.ShardScanExec):
             return plan.sharded
         if not _fully_traceable(plan):
@@ -414,6 +490,116 @@ class MeshExecutor:
                 "single-device engine or use a jax UDF:\n"
                 + plan.tree_string())
         return self._run_stage(plan)
+
+    def _adaptive_enabled(self) -> bool:
+        if FORCE_ADAPTIVE.get():
+            return True
+        try:
+            return bool(self.conf.get(CF.ADAPTIVE_ENABLED))
+        except Exception:
+            return False
+
+    # ---- adaptive execution (AQE over the mesh) -----------------------------
+
+    def _materialize_exchanges(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
+        """The AdaptiveSparkPlanExec loop (reference:
+        adaptive/AdaptiveSparkPlanExec.scala:247 createQueryStages):
+        cut the fused program at hash/range/round-robin exchange
+        boundaries, run each producer side as its own stage, measure it
+        (ExchangeStatsExec), and splice the exchanged result back in as
+        a ShardScan leaf — so every consumer re-traces against the
+        measured, bucket-rounded capacity instead of the static D*cap
+        worst case. A final-merge aggregate sitting directly on its
+        exchange is intercepted as a pair: that is where a skewed
+        destination can fan + pre-merge (see _exchange_with_stats)."""
+        if (isinstance(plan, D.DistSortAggExec)
+                and isinstance(plan.child, D.HashPartitionExchangeExec)):
+            sb = self._run_adaptive_exchange(plan.child, consumer=plan)
+            return dataclasses.replace(plan, child=D.ShardScanExec(sb))
+        if isinstance(plan, _ADAPTIVE_EXCHANGES):
+            return D.ShardScanExec(self._run_adaptive_exchange(plan))
+        fields = {}
+        changed = False
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, P.PhysicalPlan):
+                nv = self._materialize_exchanges(v)
+                changed |= nv is not v
+                fields[f.name] = nv
+            else:
+                fields[f.name] = v
+        return dataclasses.replace(plan, **fields) if changed else plan
+
+    def _run_adaptive_exchange(self, ex: P.PhysicalPlan,
+                               consumer=None) -> ShardedBatch:
+        """Run the producer side of one exchange as its own stage, then
+        the exchange itself under measured capacity bounds."""
+        child = self._materialize_exchanges(ex.child)
+        if isinstance(child, D.ShardScanExec):
+            child_sb = child.sharded
+        else:
+            child_sb = self.run(child)
+        return self._exchange_with_stats(ex, child_sb, consumer=consumer)
+
+    def _exchange_with_stats(self, ex: P.PhysicalPlan,
+                             child_sb: ShardedBatch, consumer=None,
+                             allow_skew: bool = True) -> ShardedBatch:
+        from spark_tpu import metrics
+
+        d = self.d
+        ex = dataclasses.replace(ex, child=D.ShardScanExec(child_sb))
+        stats_sb = self._run_stage(D.ExchangeStatsExec(ex))
+        # replicated psum/pmax: the flat layout puts device 0's copy
+        # first; one host fetch of 2*d int64s total
+        incoming = np.asarray(
+            stats_sb.data.columns[0].data)[:d].astype(np.int64)
+        maxslice = np.asarray(
+            stats_sb.data.columns[1].data)[:d].astype(np.int64)
+        bucket = max(1, int(self.conf.get(CF.ADAPTIVE_CAPACITY_BUCKET)))
+
+        if (allow_skew and consumer is not None and d > 1
+                and isinstance(ex, D.HashPartitionExchangeExec)
+                and incoming.size):
+            factor = int(self.conf.get(CF.ADAPTIVE_SKEW_FACTOR))
+            min_rows = int(self.conf.get(CF.ADAPTIVE_SKEW_MIN_ROWS))
+            med = float(np.median(incoming))
+            hot = [int(j) for j in range(d)
+                   if int(incoming[j]) >= min_rows
+                   and float(incoming[j]) > factor * max(1.0, med)]
+            if hot and _exactly_remergeable(consumer, child_sb.schema):
+                metrics.record(
+                    "aqe", decision="skew_split", op=_exchange_op(ex),
+                    hot=tuple(hot), max_incoming=int(incoming.max()),
+                    median=med, factor=factor)
+                # fan: hot destinations' rows stay on their balanced
+                # source devices; pre-merge collapses them to one row
+                # per (device, group); only the merged groups take the
+                # second (now un-skewed) exchange into the final merge
+                fanned = dataclasses.replace(
+                    ex, fan_destinations=tuple(hot))
+                fanned_sb = self._exchange_with_stats(
+                    fanned, child_sb, consumer=None, allow_skew=False)
+                pre_sb = self._run_stage(dataclasses.replace(
+                    consumer, child=D.ShardScanExec(fanned_sb)))
+                plain = dataclasses.replace(ex, fan_destinations=None)
+                return self._exchange_with_stats(
+                    plain, pre_sb, consumer=None, allow_skew=False)
+
+        max_in = int(incoming.max()) if incoming.size else 0
+        max_sl = int(maxslice.max()) if maxslice.size else 0
+        out_cap = K.bucket(max(1, max_in), bucket)
+        slice_cap = min(child_sb.per_device_capacity,
+                        K.bucket(max(1, max_sl), min(bucket, 128)))
+        sb = self._run_stage(dataclasses.replace(
+            ex, slice_capacity=slice_cap, out_capacity=out_cap))
+        metrics.record_exchange(
+            op=_exchange_op(ex), mode="adaptive", devices=d,
+            rows=int(incoming.sum()),
+            capacity_before=d * child_sb.per_device_capacity,
+            capacity_after=sb.per_device_capacity,
+            slice_capacity=slice_cap,
+            buffer_bytes=d * slice_cap * _row_width(child_sb.schema))
+        return sb
 
     def _materialize_boundaries(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
         if isinstance(plan, D.DistJoinBoundary):
@@ -435,7 +621,14 @@ class MeshExecutor:
 
         with metrics.stage_timer("stage", mesh=self.d,
                                  node=plan.node_string()):
-            return self._run_stage_inner(plan)
+            sb = self._run_stage_inner(plan)
+        # measured output footprint: scheduler admission prefers these
+        # over static row-count estimates once a plan has run once
+        # (scheduler/admission.note_measured_bytes, fed by
+        # DataFrame._execute from the query's stage_bytes events)
+        metrics.record("stage_bytes",
+                       bytes=int(sb.capacity) * _row_width(sb.schema))
+        return sb
 
     def _run_stage_inner(self, plan: P.PhysicalPlan) -> ShardedBatch:
         scans: List[D.ShardScanExec] = []
@@ -473,8 +666,23 @@ class MeshExecutor:
             _DIST_STAGE_CACHE[key] = entry
         jitted, schema_box = entry
         data = jitted(tuple(s.sharded.data for s in scans))
-        return self._maybe_compact(
-            ShardedBatch(schema_box["schema"], data, self.mesh))
+        sb = ShardedBatch(schema_box["schema"], data, self.mesh)
+        n_ex = _count_exchange_nodes(plan)
+        if n_ex and not self._adaptive_enabled():
+            # fused-mode observability: exchanges ran inside this stage
+            # at the static worst-case capacity; report the stage output
+            # as the post-exchange shape so padding ratios compare
+            # against adaptive mode. One mask readback per
+            # exchange-bearing stage.
+            from spark_tpu import metrics
+
+            p = sb.per_device_capacity
+            metrics.record_exchange(
+                op="fused", mode="fused", devices=self.d,
+                exchanges=n_ex, rows=sb.num_valid_rows(),
+                capacity_before=p, capacity_after=p,
+                buffer_bytes=self.d * p * _row_width(sb.schema))
+        return self._maybe_compact(sb)
 
     def _maybe_compact(self, sb: ShardedBatch) -> ShardedBatch:
         p = sb.per_device_capacity
@@ -499,6 +707,26 @@ class MeshExecutor:
 
         if self.broadcast_threshold is not None:  # legacy row threshold
             small_build = right_sb.capacity <= self.broadcast_threshold
+        elif self._adaptive_enabled():
+            # runtime broadcast switching (reference:
+            # DynamicJoinSelection.scala:40 over MapOutputStatistics):
+            # measure the build side — live rows x row width, one mask
+            # readback — instead of trusting the static capacity
+            # estimate, which a filtered build side inflates by orders
+            # of magnitude
+            from spark_tpu import metrics as _metrics
+
+            measured = (right_sb.num_valid_rows()
+                        * _row_width(right_sb.schema))
+            threshold = int(self.conf.get(
+                CF.ADAPTIVE_BROADCAST_THRESHOLD))
+            small_build = measured <= threshold
+            _metrics.record(
+                "aqe",
+                decision=("broadcast_join" if small_build
+                          else "exchange_join"),
+                measured_bytes=int(measured), threshold=threshold,
+                static_bytes=_estimated_bytes(right_sb))
         else:
             from spark_tpu import conf as _conf
 

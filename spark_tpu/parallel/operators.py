@@ -96,11 +96,23 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
     """``key_union_dicts`` (optional, per key): a unified string
     dictionary; codes translate through it before hashing so that two
     relations with different dictionaries route equal strings to the
-    same device."""
+    same device.
+
+    Adaptive fields (set by executor._run_adaptive_exchange from
+    measured stats; all participate in plan_key so re-traces at the same
+    bucket-rounded bounds hit the jit stage cache):
+    ``slice_capacity``/``out_capacity`` bound the send slice and the
+    received capacity (see exchange.exchange); ``fan_destinations``
+    reroutes rows bound for skewed destinations back to their source
+    device (exchange.fan_local) ahead of a partial-aggregate pre-merge.
+    """
 
     keys: Tuple[E.Expression, ...]
     child: P.PhysicalPlan
     key_union_dicts: Optional[Tuple[Optional[Tuple[str, ...]], ...]] = None
+    slice_capacity: Optional[int] = None
+    out_capacity: Optional[int] = None
+    fan_destinations: Optional[Tuple[int, ...]] = None
     traceable = True
 
     def children(self):
@@ -110,9 +122,7 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
     def schema(self) -> Schema:
         return self.child.schema
 
-    def trace(self, child_pipes: List[Pipe]) -> Pipe:
-        pipe = child_pipes[0]
-        d = X.axis_size()
+    def _target(self, pipe: Pipe, d: int) -> jnp.ndarray:
         env = pipe.env()
         tvs = [C.evaluate(k, env) for k in self.keys]
         if self.key_union_dicts is not None:
@@ -127,14 +137,23 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
                 translated.append(tv)
             tvs = translated
         target = X.hash_target(tvs, pipe.mask, d)
-        return X.exchange(pipe, target)
+        if self.fan_destinations:
+            target = X.fan_local(target, self.fan_destinations)
+        return target
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        return X.exchange(pipe, self._target(pipe, X.axis_size()),
+                          self.slice_capacity, self.out_capacity)
 
     def node_string(self):
         return f"Exchange[hash({', '.join(map(str, self.keys))})]"
 
     def plan_key(self):
         return ("HashExchange", tuple(E.expr_key(k) for k in self.keys),
-                self.key_union_dicts, self.child.plan_key())
+                self.key_union_dicts, self.slice_capacity,
+                self.out_capacity, self.fan_destinations,
+                self.child.plan_key())
 
 
 @dataclass(eq=False)
@@ -142,6 +161,8 @@ class RoundRobinExchangeExec(P.PhysicalPlan):
     """Balanced redistribution (RoundRobinPartitioning analogue)."""
 
     child: P.PhysicalPlan
+    slice_capacity: Optional[int] = None
+    out_capacity: Optional[int] = None
     traceable = True
 
     def children(self):
@@ -151,15 +172,18 @@ class RoundRobinExchangeExec(P.PhysicalPlan):
     def schema(self) -> Schema:
         return self.child.schema
 
+    def _target(self, pipe: Pipe, d: int) -> jnp.ndarray:
+        rank = jnp.cumsum(pipe.mask.astype(jnp.int32)) - 1
+        return ((rank + X.axis_index()) % d).astype(jnp.int32)
+
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
         pipe = child_pipes[0]
-        d = X.axis_size()
-        rank = jnp.cumsum(pipe.mask.astype(jnp.int32)) - 1
-        target = ((rank + X.axis_index()) % d).astype(jnp.int32)
-        return X.exchange(pipe, target)
+        return X.exchange(pipe, self._target(pipe, X.axis_size()),
+                          self.slice_capacity, self.out_capacity)
 
     def plan_key(self):
-        return ("RoundRobinExchange", self.child.plan_key())
+        return ("RoundRobinExchange", self.slice_capacity,
+                self.out_capacity, self.child.plan_key())
 
 
 @dataclass(eq=False)
@@ -170,6 +194,8 @@ class RangeExchangeExec(P.PhysicalPlan):
 
     orders: Tuple[E.SortOrder, ...]
     child: P.PhysicalPlan
+    slice_capacity: Optional[int] = None
+    out_capacity: Optional[int] = None
     traceable = True
 
     def children(self):
@@ -179,14 +205,16 @@ class RangeExchangeExec(P.PhysicalPlan):
     def schema(self) -> Schema:
         return self.child.schema
 
-    def trace(self, child_pipes: List[Pipe]) -> Pipe:
-        pipe = child_pipes[0]
-        d = X.axis_size()
+    def _target(self, pipe: Pipe, d: int) -> jnp.ndarray:
         o = self.orders[0]
         key = C.evaluate(o.child, pipe.env())
-        target = X.range_target(key, o.ascending, o.nulls_first_resolved, d,
-                                pipe.mask)
-        return X.exchange(pipe, target)
+        return X.range_target(key, o.ascending, o.nulls_first_resolved, d,
+                              pipe.mask)
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        return X.exchange(pipe, self._target(pipe, X.axis_size()),
+                          self.slice_capacity, self.out_capacity)
 
     def node_string(self):
         return f"Exchange[range({', '.join(map(str, self.orders))})]"
@@ -195,7 +223,55 @@ class RangeExchangeExec(P.PhysicalPlan):
         return ("RangeExchange",
                 tuple((E.expr_key(o.child), o.ascending,
                        o.nulls_first_resolved) for o in self.orders),
+                self.slice_capacity, self.out_capacity,
                 self.child.plan_key())
+
+
+@dataclass(eq=False)
+class ExchangeStatsExec(P.PhysicalPlan):
+    """Measure an exchange WITHOUT running it: re-derive the routing
+    targets (the same ``_target`` computation the exchange itself will
+    trace, so the counts are exact, not estimates) and reduce them to
+    two d-length vectors with on-device collectives — ``__incoming``
+    (psum of per-destination live counts: rows each device will
+    receive) and ``__maxslice`` (pmax: the largest single (src, dest)
+    send cell). One tiny SPMD stage, one host fetch of 2*d int64s —
+    the MapOutputStatistics of this engine (reference:
+    MapOutputTrackerMaster.getStatistics, consumed by
+    AdaptiveSparkPlanExec between stages)."""
+
+    exchange: P.PhysicalPlan  # Hash/RoundRobin/Range exchange exec
+    traceable = True
+
+    def children(self):
+        return self.exchange.children()
+
+    @property
+    def schema(self) -> Schema:
+        return Schema((Field("__incoming", T.INT64, nullable=False),
+                       Field("__maxslice", T.INT64, nullable=False)))
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        d = X.axis_size()
+        target = self.exchange._target(pipe, d)
+        local = K.seg_count(jnp.clip(target, 0, d - 1).astype(jnp.int32),
+                            pipe.mask, d)
+        incoming = X.psum(local).astype(jnp.int64)
+        maxslice = X.pmax(local).astype(jnp.int64)
+        # replicated reductions: keep device 0's copy live, like
+        # PSumAggExec, so the d-row result reads back once
+        keep = X.axis_index() == 0
+        mask = jnp.broadcast_to(keep, (d,))
+        return Pipe({"__incoming": TV(incoming, None, T.INT64, None),
+                     "__maxslice": TV(maxslice, None, T.INT64, None)},
+                    mask, ["__incoming", "__maxslice"])
+
+    def node_string(self):
+        return f"ExchangeStats[{self.exchange.node_string()}]"
+
+    def plan_key(self):
+        return ("ExchangeStats", self.exchange.plan_key())
 
 
 @dataclass(eq=False)

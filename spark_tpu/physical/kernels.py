@@ -35,6 +35,24 @@ class SortKey(NamedTuple):
     nulls_first: bool = True
 
 
+def _searchsorted_sort_threshold() -> int:
+    """spark.tpu.kernels.searchsortedSortThreshold, from the active
+    session's conf when one exists (registry default otherwise). Read
+    at trace time; the choice only affects speed, never results, so a
+    cached trace with a stale threshold stays correct."""
+    from spark_tpu import conf as CF
+
+    try:
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession._active
+        if sess is not None:
+            return int(sess.conf.get(CF.SEARCHSORTED_SORT_THRESHOLD))
+    except Exception:
+        pass
+    return int(CF.SEARCHSORTED_SORT_THRESHOLD.default)
+
+
 def searchsorted(a: jnp.ndarray, v: jnp.ndarray,
                  side: str = "left") -> jnp.ndarray:
     """Size-aware searchsorted. 'scan' (binary search) costs ~log2(a)
@@ -42,8 +60,11 @@ def searchsorted(a: jnp.ndarray, v: jnp.ndarray,
     v but catastrophic for large v (measured v5e: a=1.45M/v=1.2M scan
     564 ms vs sort 27 ms). 'sort' co-sorts the concatenation — linear in
     a+v, so it overpays when v << a (a=6M/v=10k: sort 63 ms vs scan
-    1.9 ms). Measured crossover sits near v*50 ~ a."""
-    method = ("scan" if v.size < 4096 or v.size * 50 <= a.size
+    1.9 ms). The measured crossover (v * threshold ~ a) sat near 50 on
+    v5e and is tunable per deployment via
+    spark.tpu.kernels.searchsortedSortThreshold."""
+    threshold = _searchsorted_sort_threshold()
+    method = ("scan" if v.size < 4096 or v.size * threshold <= a.size
               else "sort")
     return jnp.searchsorted(a, v, side=side, method=method)
 

@@ -243,6 +243,24 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
 _COMPACT_STATS = P._AdaptiveStatsCache()
 
 
+def _capacity_bucket() -> int:
+    """Compaction capacities round up to
+    spark.tpu.adaptive.capacityBucket (active-session conf; registry
+    default 1024 reproduces the historical hard-coded multiple) — the
+    same bucket adaptive exchanges use, so single-device and
+    distributed re-traces share one small set of capacities and the
+    jit stage caches stay hot."""
+    try:
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession._active
+        if sess is not None:
+            return max(1, int(sess.conf.get(CF.ADAPTIVE_CAPACITY_BUCKET)))
+    except Exception:
+        pass
+    return max(1, int(CF.ADAPTIVE_CAPACITY_BUCKET.default))
+
+
 def _compact_to(batch: Batch, new_cap: int) -> Batch:
     """Route through CompactExec so the blocking-run compaction and the
     traced replay are structurally the SAME code — _JOIN_INDEX position
@@ -266,7 +284,8 @@ def _maybe_compact(batch: Batch, child: P.PhysicalPlan) -> Batch:
         if not P.stats_recording():
             return batch  # single-shot plan: skip the sizing sync
         live = int(np.asarray(batch.data.row_mask).sum())  # host sync
-        new_cap = K.bucket(live) if live * 4 <= cap else 0
+        new_cap = K.bucket(live, _capacity_bucket()) \
+            if live * 4 <= cap else 0
         _COMPACT_STATS.put(sk, new_cap)
     if not new_cap or new_cap >= cap:
         return batch
@@ -314,7 +333,7 @@ def execute(plan: P.PhysicalPlan) -> Batch:
     batch = _execute(plan)
     if P.stats_recording():
         live = int(np.asarray(batch.data.row_mask).sum())  # 1st run only
-        _OUTPUT_STATS.put(sk, K.bucket(live))
+        _OUTPUT_STATS.put(sk, K.bucket(live, _capacity_bucket()))
     return batch
 
 

@@ -173,6 +173,40 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
             raise
         last = e
 
+    # rung 0: adaptive execution. When the OOM hit with
+    # spark.tpu.adaptive.enabled off, retry ONCE with it forced on —
+    # exchange-heavy plans OOM on the D x cap receive buffers, and
+    # measured post-exchange compaction shrinks exactly those while
+    # producing byte-identical results. Cheaper than chunking (no
+    # re-decode), so it goes first; a contextvar (not the shadow conf)
+    # carries the override because run_fn closes over the SESSION conf.
+    from spark_tpu.parallel import executor as _mex
+
+    sess = None
+    try:
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession._active
+    except Exception:
+        pass
+    adaptive_off = not (_mex.FORCE_ADAPTIVE.get()
+                        or bool(conf.get(_mex.CF.ADAPTIVE_ENABLED)))
+    if adaptive_off and sess is not None \
+            and getattr(sess, "_mesh", None) is not None:
+        metrics.record("degraded_to_adaptive", error=repr(last))
+        token = _mex.FORCE_ADAPTIVE.set(True)
+        try:
+            out = run_fn(lp)
+            metrics.record("fault_recovered", point="execute.device",
+                           how="degraded_to_adaptive")
+            return out
+        except Exception as e2:
+            if not is_oom(e2):
+                raise
+            last = e2  # adaptive compaction was not enough: chunk
+        finally:
+            _mex.FORCE_ADAPTIVE.reset(token)
+
     budget = int(conf.get(MAX_DEVICE_BATCH_BYTES))
     floor = max(1, int(conf.get(OOM_DEGRADE_FLOOR)))
     # shadow conf: the ladder's shrinking budget must not leak into the

@@ -27,14 +27,60 @@ from spark_tpu import conf as CF
 #: exceeds the signal and admission decisions would thrash
 MIN_ESTIMATE_BYTES = 64 * 1024
 
+#: measured stage footprints from prior executions, keyed by the
+#: logical plan's injective structural_key() (adaptive execution's
+#: answer to "use measured, not static, plan bytes once stats exist":
+#: DataFrame._execute notes the max stage_bytes event of each finished
+#: query here; estimate_plan_bytes prefers a recorded measurement over
+#: the static row-count estimate). Bounded LRU under a lock —
+#: structural keys pin source objects by id, so unbounded growth would
+#: also pin dead batches.
+_MEASURED_LOCK = threading.Lock()
+_MEASURED_MAX_ENTRIES = 512
+_MEASURED: "dict" = {}
+
+
+def note_measured_bytes(plan, nbytes: int) -> None:
+    """Record the measured peak stage footprint of an executed logical
+    plan (no-op when the key cannot be computed or the value is
+    non-positive)."""
+    if nbytes <= 0:
+        return
+    try:
+        key = plan.structural_key()
+    except Exception:
+        return
+    with _MEASURED_LOCK:
+        # re-insertion moves the key to the back of the dict (LRU-ish:
+        # python dicts preserve insertion order)
+        prev = _MEASURED.pop(key, 0)
+        _MEASURED[key] = max(int(nbytes), prev)
+        while len(_MEASURED) > _MEASURED_MAX_ENTRIES:
+            _MEASURED.pop(next(iter(_MEASURED)))
+
+
+def measured_plan_bytes(plan):
+    """The recorded measurement for this plan shape, or None."""
+    try:
+        key = plan.structural_key()
+    except Exception:
+        return None
+    with _MEASURED_LOCK:
+        return _MEASURED.get(key)
+
 
 def estimate_plan_bytes(plan, conf) -> int:
-    """Estimated device footprint of executing ``plan``: max over plan
-    nodes of estimated rows x 8-byte columns (x64 engine). Falls back
-    to the device batch budget when estimation fails — unknown plans
-    admit serially rather than stampeding HBM."""
+    """Estimated device footprint of executing ``plan``: a MEASURED
+    peak stage footprint from a prior run of the same plan shape when
+    one exists (note_measured_bytes), else max over plan nodes of
+    estimated rows x 8-byte columns (x64 engine). Falls back to the
+    device batch budget when estimation fails — unknown plans admit
+    serially rather than stampeding HBM."""
     from spark_tpu.physical.chunked import MAX_DEVICE_BATCH_BYTES
 
+    measured = measured_plan_bytes(plan)
+    if measured is not None:
+        return max(MIN_ESTIMATE_BYTES, int(measured))
     try:
         from spark_tpu.plan.join_reorder import estimate_rows
 

@@ -140,8 +140,90 @@ def format_pipeline_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
+def exchange_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up the last query's exchange events (metrics.record_exchange
+    + "aqe" decision events) into {"exchanges", "rows_sent",
+    "buffer_bytes", "padding_ratio", "by_op": {op: {count, rows,
+    buffer_bytes, capacity_before, capacity_after, padding_ratio}},
+    "decisions": [...]} — the MapOutputStatistics view of what each
+    shuffle actually moved. ``capacity_*`` are PER-DEVICE: with
+    adaptive execution on, ``capacity_after`` is the bucket-rounded
+    pmax of measured live counts (vs the D x local-capacity worst case
+    in ``capacity_before``); in fused mode the two are equal (the stage
+    output shape). ``padding_ratio`` = 1 - live rows / total
+    post-exchange slots. "aqe" decisions record broadcast-join
+    switches and skew splits."""
+    evs = events if events is not None else metrics.last_query()
+    by_op: Dict[str, dict] = {}
+    decisions: List[dict] = []
+    total_rows = total_bytes = total_slots = n_exchanges = 0
+    for e in evs:
+        kind = e.get("kind")
+        if kind == "aqe":
+            decisions.append({k: v for k, v in e.items()
+                              if k not in ("n", "ts", "kind")})
+            continue
+        if kind != "exchange":
+            continue
+        n = int(e.get("exchanges", 1))
+        rows = int(e.get("rows", 0))
+        nbytes = int(e.get("buffer_bytes", 0))
+        slots = int(e.get("capacity_after", 0)) * int(e.get("devices", 1))
+        n_exchanges += n
+        total_rows += rows
+        total_bytes += nbytes
+        total_slots += slots
+        rec = by_op.setdefault(e.get("op", "?"), {
+            "count": 0, "rows": 0, "buffer_bytes": 0, "slots": 0,
+            "capacity_before": 0, "capacity_after": 0, "mode": None})
+        rec["count"] += n
+        rec["rows"] += rows
+        rec["buffer_bytes"] += nbytes
+        rec["slots"] += slots
+        rec["capacity_before"] = max(rec["capacity_before"],
+                                     int(e.get("capacity_before", 0)))
+        rec["capacity_after"] = max(rec["capacity_after"],
+                                    int(e.get("capacity_after", 0)))
+        rec["mode"] = e.get("mode")
+    for rec in by_op.values():
+        s = rec.pop("slots")
+        rec["padding_ratio"] = round(1.0 - rec["rows"] / s, 4) if s \
+            else 0.0
+    return {
+        "exchanges": n_exchanges,
+        "rows_sent": total_rows,
+        "buffer_bytes": total_bytes,
+        "padding_ratio": (round(1.0 - total_rows / total_slots, 4)
+                          if total_slots else 0.0),
+        "by_op": by_op,
+        "decisions": decisions,
+    }
+
+
+def format_exchange_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else exchange_profile()
+    if not p.get("exchanges") and not p.get("decisions"):
+        return "(no exchange events recorded)"
+    lines = [
+        f"exchanges={p['exchanges']} rows_sent={p['rows_sent']} "
+        f"ici_buffer_bytes={p['buffer_bytes']} "
+        f"padding_ratio={p['padding_ratio']:.2%}"]
+    for op, rec in sorted(p.get("by_op", {}).items()):
+        lines.append(
+            f"  {op} ({rec.get('mode', '?')}): count={rec['count']} "
+            f"rows={rec['rows']} cap {rec['capacity_before']}->"
+            f"{rec['capacity_after']}/dev "
+            f"padding={rec['padding_ratio']:.2%}")
+    for d in p.get("decisions", []):
+        desc = " ".join(f"{k}={v}" for k, v in d.items()
+                        if k != "decision")
+        lines.append(f"  aqe: {d.get('decision', '?')} {desc}".rstrip())
+    return "\n".join(lines)
+
+
 _FAULT_EVENTS = ("fault_injected", "fault_recovered",
-                 "degraded_to_chunked", "stage_retry", "chunk_retry")
+                 "degraded_to_chunked", "degraded_to_adaptive",
+                 "stage_retry", "chunk_retry")
 
 
 def fault_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:

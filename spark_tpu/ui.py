@@ -11,6 +11,8 @@ Endpoints:
   /api/v1/events?n=200  recent raw events (JSON)
   /api/v1/status        app name, event count, active query
   /api/v1/storage       HBM store occupancy, counters, entry listing
+  /api/v1/exchange      shuffle stats: rows/bytes/padding per exchange,
+                        adaptive (AQE) decisions, exchange.* gauges
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -144,6 +146,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "heartbeat": hb.status() if hb is not None else None,
                 "scheduler": _scheduler_status(session),
                 "storage": _storage_status(session),
+            })
+        elif url.path == "/api/v1/exchange":
+            from spark_tpu import tracing
+
+            self._json({
+                "profile": tracing.exchange_profile(events),
+                "gauges": {k: v for k, v in metrics.gauges().items()
+                           if k.startswith("exchange.")},
             })
         elif url.path == "/api/v1/storage":
             session = getattr(self.server, "spark_session", None)

@@ -74,6 +74,11 @@ def pytest_configure(config):
         "markers",
         "storage: HBM-resident columnar storage / unified memory "
         "manager tests (spark_tpu/storage/)")
+    config.addinivalue_line(
+        "markers",
+        "aqe: adaptive query execution over the mesh — runtime "
+        "shuffle stats, capacity re-planning, broadcast switching, "
+        "skew splitting")
 
 
 def pytest_collection_modifyitems(config, items):

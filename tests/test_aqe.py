@@ -1,0 +1,400 @@
+"""Adaptive query execution over the ICI mesh (reference:
+adaptive/AdaptiveSparkPlanExec.scala, DynamicJoinSelection.scala,
+OptimizeSkewedJoin.scala).
+
+The hard invariant under test: with ``spark.tpu.adaptive.enabled`` the
+engine may re-trace consumer stages at compacted capacities, switch a
+join to broadcast, or fan a skewed partition across replicas — but the
+RESULT BYTES never change. Group-by/sort outputs compare exactly
+(including float payloads: compaction preserves live-row order, so
+reductions see operands in the same sequence); bare joins compare as
+sorted rows (a broadcast switch legitimately permutes row order).
+"""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax.numpy as jnp
+
+import spark_tpu.conf as CF
+import spark_tpu.expr.expressions as E
+import spark_tpu.plan.logical as L
+from spark_tpu import metrics, tracing
+from spark_tpu.columnar.arrow import from_arrow
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.parallel.executor import MeshExecutor
+from spark_tpu.parallel.mesh import make_mesh
+from spark_tpu.physical import kernels as K
+from spark_tpu.physical.planner import execute_logical
+
+pytestmark = pytest.mark.aqe
+
+_MESHES = {}
+
+
+def _mesh(d):
+    if d not in _MESHES:
+        _MESHES[d] = make_mesh(d)
+    return _MESHES[d]
+
+
+def _executor(d, adaptive, **overrides):
+    conf = RuntimeConf({"spark.tpu.adaptive.enabled": bool(adaptive),
+                        **overrides})
+    return MeshExecutor(_mesh(d), conf=conf)
+
+
+def _rows(batch):
+    return [tuple(d.values()) for d in batch.to_pylist()]
+
+
+def _assert_rows_close(got, want):
+    """Mesh vs single-device oracle: exact on ints, ulp-tolerant on
+    floats (a distributed float sum legitimately reduces in a different
+    order than the single-device engine — the byte-identity invariant
+    is adaptive-on vs adaptive-off, both on the SAME mesh)."""
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert len(g) == len(w)
+        for gv, wv in zip(g, w):
+            if isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=1e-9)
+            else:
+                assert gv == wv
+
+
+def _hash_dest(keys, d):
+    """Host-side replica of exchange.hash_target for int64 key columns
+    (lets tests place keys on chosen devices deterministically)."""
+    h = K.hash_combine(jnp.zeros((len(keys),), jnp.uint64),
+                       jnp.asarray(np.asarray(keys, np.int64)))
+    return np.asarray(h % jnp.uint64(d)).astype(int)
+
+
+def _table(keys, vals):
+    return L.Relation(from_arrow(pa.table({
+        "k": pa.array(np.asarray(keys, np.int64), pa.int64()),
+        "v": pa.array(np.asarray(vals, np.int64), pa.int64()),
+        "f": pa.array(np.asarray(vals, np.float64) * 0.25 + 0.1,
+                      pa.float64()),
+    })))
+
+
+def _groupby_sort(rel):
+    agg = L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Sum(E.Col("v")), "s"),
+         E.Alias(E.Count(E.Col("v")), "n"),
+         E.Alias(E.Min(E.Col("v")), "mn"),
+         E.Alias(E.Max(E.Col("v")), "mx"),
+         E.Alias(E.Sum(E.Col("f")), "fs")),
+        rel)
+    return L.Sort((E.SortOrder(E.Col("k")),), agg)
+
+
+def _dataset(dist, rng, n=6000):
+    if dist == "uniform":
+        keys = rng.integers(0, 200, n)
+    else:  # skewed: 90% of rows share one key
+        keys = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 200, n))
+    return _table(keys, rng.integers(0, 1000, n))
+
+
+# ---- the hard invariant: byte-identical results on/off ----------------------
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+@pytest.mark.parametrize("dist", ["uniform", "skewed"])
+@pytest.mark.timeout(300)
+def test_byte_identity_groupby_sort(devices, dist, rng):
+    rel = _dataset(dist, rng)
+    plan = _groupby_sort(rel)
+    off = _rows(_executor(devices, False).execute_logical(plan))
+    on = _rows(_executor(devices, True).execute_logical(plan))
+    # exact equality, float payloads included: the whole point of AQE
+    # stage re-tracing is that compaction never reorders live rows
+    assert on == off
+    _assert_rows_close(on, _rows(execute_logical(plan)))
+
+
+# ---- capacity re-planning: post-exchange capacity ≤ bucketed pmax ----------
+
+
+@pytest.mark.parametrize("bucket", [1, 64])
+@pytest.mark.timeout(300)
+def test_capacity_compaction_bucket_edges(bucket, rng):
+    """All-distinct int keys (no partial-agg collapse): the hash
+    exchange carries exactly n rows, and the expected per-destination
+    incoming counts are computable host-side from the same avalanche
+    hash. The re-traced consumer stage must see capacity ==
+    bucket-rounded pmax of live counts — NOT D x the producer capacity
+    (the static-shape cascade AQE exists to break). bucket=1 is the
+    tight edge: capacity_after equals the max live count exactly."""
+    d, n = 8, 4096
+    keys = np.arange(n, dtype=np.int64)
+    rel = _table(keys, rng.integers(0, 1000, n))
+    plan = _groupby_sort(rel)
+
+    counts = np.bincount(_hash_dest(keys, d), minlength=d)
+    expected = int(K.bucket(int(counts.max()), bucket))
+
+    metrics.query_start("aqe-capacity-test")
+    got = _rows(_executor(
+        d, True,
+        **{"spark.tpu.adaptive.capacityBucket": bucket}
+    ).execute_logical(plan))
+    _assert_rows_close(got, _rows(execute_logical(plan)))
+
+    prof = tracing.exchange_profile(metrics.last_query())
+    hash_ex = prof["by_op"]["hash"]
+    assert hash_ex["mode"] == "adaptive"
+    assert hash_ex["rows"] == n
+    assert hash_ex["capacity_after"] == expected
+    # compaction beat the static-shape cascade: the uncompacted receive
+    # capacity would be D x the producer's 512/dev = capacity_before;
+    # adaptive re-tracing sized the consumer at the measured pmax
+    assert hash_ex["capacity_after"] < hash_ex["capacity_before"]
+    assert hash_ex["capacity_before"] == d * (n // d)
+
+
+# ---- broadcast-join switching at the measured threshold boundary -----------
+
+
+@pytest.mark.timeout(300)
+def test_broadcast_switch_threshold_boundary(rng):
+    d, n = 8, 4000
+    left = L.Relation(from_arrow(pa.table({
+        "k": pa.array(rng.integers(0, 64, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })))
+    right = L.Relation(from_arrow(pa.table({
+        "k2": pa.array(np.arange(64, dtype=np.int64), pa.int64()),
+        "w": pa.array(np.arange(64, dtype=np.int64) * 10, pa.int64()),
+    })))
+    join = L.Join(left, right, "inner", (E.Col("k"),), (E.Col("k2"),))
+
+    def run(threshold):
+        metrics.query_start("aqe-broadcast-test")
+        out = _executor(
+            d, True,
+            **{"spark.tpu.adaptive.autoBroadcastJoinThreshold": threshold}
+        ).execute_logical(join)
+        decisions = [e for e in metrics.last_query()
+                     if e.get("kind") == "aqe"
+                     and e.get("decision") in ("broadcast_join",
+                                               "exchange_join")]
+        assert decisions, "adaptive join made no recorded decision"
+        return sorted(_rows(out)), decisions[-1]
+
+    oracle = sorted(_rows(execute_logical(join)))
+    rows_hi, dec_hi = run(1 << 30)
+    measured = dec_hi["measured_bytes"]
+    assert dec_hi["decision"] == "broadcast_join"
+    # boundary: threshold == measured bytes still broadcasts (<=), one
+    # byte below falls back to hash-exchanging both sides
+    rows_eq, dec_eq = run(measured)
+    rows_lo, dec_lo = run(measured - 1)
+    assert dec_eq["decision"] == "broadcast_join"
+    assert dec_lo["decision"] == "exchange_join"
+    assert rows_hi == rows_eq == rows_lo == oracle
+
+
+# ---- skew split composes with the OOM degradation ladder -------------------
+
+
+@pytest.fixture()
+def mesh_session():
+    """A mesh[8]-backed session, restoring whatever session was active
+    before (the module must not leak a mesh engine into single-device
+    suites)."""
+    from spark_tpu.api.session import SparkSession
+
+    prev = SparkSession._active
+    SparkSession._reset()
+    spark = (SparkSession.builder.master("mesh[8]")
+             .appName("aqe-test").getOrCreate())
+    yield spark
+    SparkSession._reset()
+    SparkSession._active = prev
+
+
+_SKEW_CONF_KEYS = (
+    "spark.tpu.adaptive.enabled",
+    "spark.tpu.adaptive.skewedPartitionFactor",
+    "spark.tpu.adaptive.skewMinRows",
+    "spark.tpu.faultInjection.execute.device",
+)
+
+
+@pytest.mark.timeout(600)
+def test_skew_split_and_oom_ladder_composition(mesh_session, rng):
+    """An injected whole-batch OOM with adaptive OFF degrades to rung 0
+    of the ladder (forced adaptive re-execution, no re-decode), where
+    the skewed hash destination — thousands of DISTINCT keys that all
+    hash to one device, so partial aggregation cannot collapse them —
+    is fanned across replicas and re-merged. Events must show the full
+    story and the result must match the no-fault run exactly."""
+    from spark_tpu import faults
+
+    spark = mesh_session
+    d = 8
+    cand = np.arange(60_000, dtype=np.int64)
+    dest = _hash_dest(cand, d)
+    hot = cand[dest == 0][:6000]
+    cold = cand[dest != 0][:64]
+    keys = np.concatenate([hot, cold])
+    vals = rng.integers(0, 1000, keys.size)
+    import pandas as pd
+
+    spark.createDataFrame(pd.DataFrame({"k": keys, "v": vals})) \
+        .createOrReplaceTempView("aqe_skew")
+    q = ("SELECT k, sum(v) s, count(*) c, min(v) mn, max(v) mx "
+         "FROM aqe_skew GROUP BY k ORDER BY k")
+    try:
+        spark.conf.set("spark.tpu.adaptive.enabled", False)
+        ref = spark.sql(q).toArrow()
+
+        spark.conf.set("spark.tpu.adaptive.skewedPartitionFactor", 2)
+        spark.conf.set("spark.tpu.adaptive.skewMinRows", 256)
+        spark.conf.set("spark.tpu.faultInjection.execute.device",
+                       "nth:1:oom")
+        faults.reset(spark.conf)
+        got = spark.sql(q).toArrow()
+        assert got.equals(ref)
+
+        events = metrics.recent(8192)
+        kinds = [e["kind"] for e in events]
+        assert "degraded_to_adaptive" in kinds
+        assert any(e["kind"] == "fault_recovered"
+                   and e.get("how") == "degraded_to_adaptive"
+                   for e in events)
+        splits = [e for e in events if e.get("kind") == "aqe"
+                  and e.get("decision") == "skew_split"]
+        assert splits and 0 in splits[-1]["hot"]
+        assert splits[-1]["max_incoming"] >= 6000
+    finally:
+        for key in _SKEW_CONF_KEYS:
+            spark.conf.unset(key)
+        faults.reset(spark.conf)
+
+
+# ---- observability: the UI serves the exchange profile ---------------------
+
+
+@pytest.mark.timeout(300)
+def test_ui_exchange_endpoint(mesh_session, rng):
+    import json
+    import urllib.request
+
+    from spark_tpu.ui import StatusServer
+
+    spark = mesh_session
+    import pandas as pd
+
+    spark.createDataFrame(pd.DataFrame({
+        "k": rng.integers(0, 100, 4000),
+        "v": rng.integers(0, 1000, 4000),
+    })).createOrReplaceTempView("aqe_ui")
+    try:
+        spark.conf.set("spark.tpu.adaptive.enabled", True)
+        spark.sql("SELECT k, sum(v) s FROM aqe_ui GROUP BY k "
+                  "ORDER BY k").collect()
+    finally:
+        spark.conf.unset("spark.tpu.adaptive.enabled")
+    srv = StatusServer(spark, port=0)
+    try:
+        with urllib.request.urlopen(f"{srv.url}/api/v1/exchange",
+                                    timeout=10) as r:
+            payload = json.loads(r.read())
+    finally:
+        srv.stop()
+    prof = payload["profile"]
+    assert prof["exchanges"] >= 1 and prof["rows_sent"] > 0
+    assert any(ex["mode"] == "adaptive" for ex in prof["by_op"].values())
+    assert payload["gauges"].get("exchange.mode") == "adaptive"
+
+
+# ---- measured admission: scheduler uses observed, not static, bytes --------
+
+
+@pytest.mark.timeout(300)
+def test_measured_bytes_feed_admission(mesh_session, rng):
+    from spark_tpu.scheduler import admission
+
+    spark = mesh_session
+    import pandas as pd
+
+    spark.createDataFrame(pd.DataFrame({
+        "k": rng.integers(0, 50, 4000),
+        "v": rng.integers(0, 1000, 4000),
+    })).createOrReplaceTempView("aqe_adm")
+    df = spark.sql("SELECT k, sum(v) s FROM aqe_adm GROUP BY k ORDER BY k")
+    df.collect()
+    measured = admission.measured_plan_bytes(df._plan)
+    assert measured is not None and measured > 0
+    est = admission.estimate_plan_bytes(df._plan, spark.conf)
+    assert est == max(admission.MIN_ESTIMATE_BYTES, measured)
+
+
+# ---- conf hygiene ----------------------------------------------------------
+
+
+def test_all_adaptive_conf_keys_declared():
+    """Every spark.tpu.adaptive.* / spark.tpu.kernels.* key referenced
+    anywhere in the source is registered in conf.py with a default and
+    a docstring (the declaration contract the storage suite pioneered)."""
+    root = os.path.join(os.path.dirname(__file__), "..", "spark_tpu")
+    used = set()
+    for path in glob.glob(os.path.join(root, "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            used.update(re.findall(
+                r"spark\.tpu\.(?:adaptive|kernels)\.\w+", f.read()))
+    assert used, "no adaptive/kernels conf keys found in source"
+    for key in used:
+        assert key in CF._REGISTRY, f"{key} not registered in conf.py"
+        entry = CF._REGISTRY[key]
+        assert entry.doc and len(entry.doc) > 20, f"{key} lacks a doc"
+        assert entry.default is not None, f"{key} lacks a default"
+
+
+def test_searchsorted_sort_threshold_conf(monkeypatch):
+    """kernels.searchsorted flips scan->sort when
+    v.size * threshold > a.size; the threshold must come from the
+    active session conf, falling back to the declared default."""
+    from spark_tpu.api.session import SparkSession
+
+    captured = {}
+    real = jnp.searchsorted
+
+    def spy(a, v, side="left", method="scan"):
+        captured["method"] = method
+        return real(a, v, side=side, method=method)
+
+    monkeypatch.setattr(K.jnp, "searchsorted", spy)
+    a = jnp.arange(8192, dtype=jnp.int64)
+    v = jnp.arange(4096, dtype=jnp.int64)
+
+    prev = SparkSession._active
+    SparkSession._reset()
+    spark = SparkSession.builder.appName("aqe-kernels").getOrCreate()
+    try:
+        assert (K._searchsorted_sort_threshold()
+                == CF.SEARCHSORTED_SORT_THRESHOLD.default)
+        # default threshold (50): 4096 * 50 >> 8192 -> sort-based merge
+        K.searchsorted(a, v)
+        assert captured["method"] == "sort"
+        # threshold 1: 4096 * 1 <= 8192 -> per-element binary search
+        spark.conf.set("spark.tpu.kernels.searchsortedSortThreshold", 1)
+        assert K._searchsorted_sort_threshold() == 1
+        K.searchsorted(a, v)
+        assert captured["method"] == "scan"
+    finally:
+        spark.conf.unset("spark.tpu.kernels.searchsortedSortThreshold")
+        SparkSession._reset()
+        SparkSession._active = prev
