@@ -306,6 +306,15 @@ class DataFrame:
             from spark_tpu.recovery import run_plan_with_oom_degradation
 
             lp = opt(plan)
+            svc = self._session.compile_service
+            if svc is not None:
+                # compile-service routing: with background compile on,
+                # serve through the chunked tier while the fused
+                # executable compiles off-thread (byte-identical
+                # either way)
+                return svc.execute_plan(
+                    lp, self._session.conf,
+                    lambda p: run(p, optimize=False))
             return run_plan_with_oom_degradation(
                 lp, self._session.conf,
                 lambda p: run(p, optimize=False))
@@ -314,6 +323,13 @@ class DataFrame:
         if self._session is not None:
             from spark_tpu.recovery import run_stage_with_recovery
             from spark_tpu.storage import pin_scope
+
+            svc = self._session.compile_service
+            if svc is not None:
+                # journal the served plan (+ SQL text when this frame
+                # came from session.sql) for the pre-warm replay
+                svc.note_served(self._plan,
+                                sql=getattr(self, "_sql_text", None))
 
             # pin_scope: every MemoryStore entry this query reads
             # (cached plans, auto-cached scans) is held against

@@ -113,12 +113,16 @@ def _harden_cache_writes() -> None:
 
 
 def _instrument_compile_cache() -> None:
-    """Count persistent compilation-cache hits/misses. jax's lookup
-    funnel is ``compilation_cache.get_executable_and_time`` — returns a
+    """Count persistent compilation-cache hits/misses, and keep the
+    managed executable store within its byte bound. jax's lookup funnel
+    is ``compilation_cache.get_executable_and_time`` — returns a
     deserialized executable on a disk hit, None on a miss (followed by
-    a fresh XLA compile). Wrapping it feeds metrics.note_compile_cache
-    so warmup time is attributable: was the 6-55 s spent compiling or
-    loading AOT artifacts?"""
+    a fresh XLA compile, which jax then writes back to the cache dir).
+    Wrapping it feeds metrics.note_compile_cache so warmup time is
+    attributable, and — when the compile service routes jax's cache
+    inside the spark.tpu.compile.store.dir root — schedules LRU budget
+    enforcement after each miss, so jax's own cache writes count
+    against the same size bound as our AOT entries."""
     try:
         from jax._src import compilation_cache as _cc
     except Exception:
@@ -133,7 +137,18 @@ def _instrument_compile_cache() -> None:
         out = _orig(*a, **kw)
         try:
             executable = out[0] if isinstance(out, tuple) else out
-            _metrics.note_compile_cache(executable is not None)
+            hit = executable is not None
+            _metrics.note_compile_cache(hit)
+            if not hit:
+                # a miss means jax is about to write a fresh cache
+                # entry: re-check the managed store's byte bound
+                # (misses happen once per compile — seconds apart —
+                # so the directory walk is off the hot path)
+                from spark_tpu.compile.service import active_service
+
+                svc = active_service()
+                if svc is not None and svc.store is not None:
+                    svc.store.enforce_budget()
         except Exception:
             pass
         return out
@@ -422,6 +437,17 @@ class SparkSession:
             self._mesh_executor = MeshExecutor(self._mesh, conf=self.conf)
         return self._mesh_executor
 
+    @property
+    def compile_service(self):
+        """AOT compilation service (spark_tpu/compile/) when any
+        spark.tpu.compile.* feature is enabled; None otherwise —
+        callers treat None as 'legacy behavior'. Re-resolved per
+        access so conf changes (store dir, background flag) take
+        effect immediately."""
+        from spark_tpu.compile import maybe_service
+
+        return maybe_service(self)
+
     # -- builder is reset-safe for tests
     @classmethod
     def _reset(cls):
@@ -482,7 +508,11 @@ class SparkSession:
         self._ensure_active()
         # injected parser hooks first (injectParser:318 analogue)
         plan = self.extensions.parse(query, self.catalog, parse_sql)
-        return DataFrame(self, plan)
+        df = DataFrame(self, plan)
+        # carried for the compile service's served-plan history: SQL
+        # text is the cross-process-replayable identity of this plan
+        df._sql_text = query
+        return df
 
     def createDataFrame(
         self,
@@ -524,6 +554,9 @@ class SparkSession:
 
     def stop(self) -> None:
         self._stopped = True
+        svc = self.__dict__.pop("_compile_service", None)
+        if svc is not None:
+            svc.stop()
         if self._ui is not None:
             self._ui.stop()
             self._ui = None

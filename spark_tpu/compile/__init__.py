@@ -1,0 +1,24 @@
+"""AOT compilation service: cross-session executable cache, plan-history
+pre-warm, and background compile with hot-swap (ROADMAP item 1).
+
+Flare (PAPERS.md, arxiv 1703.08219) is the design reference: compiled
+whole-query executables are the *product* — persisted, keyed, and
+served — not a side-effect of the jit cache. ``store`` owns the
+on-disk executable store (stable plan fingerprints + serialized XLA
+executables); ``service`` owns the session-facing policy (stage-cache
+integration, background compile + hot-swap routing, served-plan
+history, pre-warm).
+"""
+
+from spark_tpu.compile.service import (CompileService, active_service,
+                                       build_stage_callable, maybe_service)
+from spark_tpu.compile.store import ExecutableStore, stable_plan_fingerprint
+
+__all__ = [
+    "CompileService",
+    "ExecutableStore",
+    "active_service",
+    "build_stage_callable",
+    "maybe_service",
+    "stable_plan_fingerprint",
+]

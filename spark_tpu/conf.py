@@ -250,6 +250,80 @@ SEARCHSORTED_SORT_THRESHOLD = register(
     "prefer sort (wide all-to-all style lookups), lower it toward 0 to "
     "prefer scan (few queries against huge sorted runs).", int)
 
+# ---- AOT compilation service (spark_tpu/compile/) --------------------------
+
+COMPILE_STORE_DIR = register(
+    "spark.tpu.compile.store.dir", "",
+    "Root directory of the cross-session executable store: serialized "
+    "AOT stage executables (entries/) plus jax's persistent XLA cache "
+    "(xla/) live here, keyed by a stable plan fingerprint + "
+    "capacity/mesh/device-kind, so a fresh session or worker restart "
+    "skips XLA entirely. Empty disables cross-session persistence "
+    "(the in-process jit stage caches still apply).", str)
+
+COMPILE_STORE_MAX_BYTES = register(
+    "spark.tpu.compile.store.maxBytes", 1 << 30,
+    "Size bound for the executable store directory (AOT entries + the "
+    "managed jax persistent-cache subdir); beyond it the least-"
+    "recently-used entry files are evicted.", int)
+
+COMPILE_STORE_SERIALIZE = register(
+    "spark.tpu.compile.store.serialize", True,
+    "Persist freshly compiled stage executables to the store via "
+    "jax.experimental.serialize_executable. Off = lookups only (useful "
+    "on hosts where XLA executable serialization is unreliable).", bool)
+
+COMPILE_BACKGROUND = register(
+    "spark.tpu.compile.background", False,
+    "On an executable-cache miss, admit the query anyway: serve the "
+    "first request(s) through the chunked tier while the fused "
+    "executable compiles on a background thread, then atomically swap "
+    "it in for subsequent execution — byte-identical either way. A "
+    "background-compile failure pins the plan to the chunked tier "
+    "permanently (no swap, no crash).", bool)
+
+COMPILE_CHUNK_FIRST_BUDGET = register(
+    "spark.tpu.compile.chunkFirst.budgetBytes", 32 << 20,
+    "Shadow spark.tpu.maxDeviceBatchBytes used to force the chunked "
+    "tier while the fused executable compiles in the background (the "
+    "chunked tier's small per-chunk programs compile in a fraction of "
+    "the fused program's time).", int)
+
+COMPILE_HISTORY_PATH = register(
+    "spark.tpu.compile.history.path", "",
+    "Served-plan history file (JSONL of executed SQL + plan "
+    "fingerprints) replayed by the pre-warm pass. Empty defaults to "
+    "<store.dir>/plan_history.jsonl when the store is enabled.", str)
+
+COMPILE_HISTORY_MAX_ENTRIES = register(
+    "spark.tpu.compile.history.maxEntries", 512,
+    "Distinct plans kept in the served-plan history (the file is "
+    "compacted beyond roughly twice this many lines).", int)
+
+COMPILE_PREWARM_ENABLED = register(
+    "spark.tpu.compile.prewarm.enabled", True,
+    "Replay the served-plan history at connect-server start on a "
+    "background worker, most-frequent-first, pre-tracing and "
+    "pre-compiling stage executables before the first client query "
+    "arrives.", bool)
+
+COMPILE_PREWARM_BUDGET_S = register(
+    "spark.tpu.compile.prewarm.budgetSeconds", 120.0,
+    "Wall-clock budget for the pre-warm replay; remaining history "
+    "entries are skipped (marked in the pre-warm report) once it is "
+    "spent.", float)
+
+COMPILE_PREWARM_MAX_QUERIES = register(
+    "spark.tpu.compile.prewarm.maxQueries", 32,
+    "Most-frequent-first cap on how many distinct history plans the "
+    "pre-warm pass replays.", int)
+
+COMPILE_PREWARM_WORKERS = register(
+    "spark.tpu.compile.prewarm.workers", 1,
+    "Worker threads replaying the served-plan history concurrently "
+    "during pre-warm. 1 = sequential (deterministic replay order); "
+    "more overlaps XLA compiles of independent plans.", int)
+
 
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""

@@ -118,7 +118,8 @@ class ConnectServer:
                         description=req.get("query",
                                             f"plan:{self.path}"),
                         deadline_s=float(deadline_s)
-                        if deadline_s is not None else None)
+                        if deadline_s is not None else None,
+                        sql=req.get("query"))
                     tbl = ticket.result()
                     sink = io.BytesIO()
                     with pa.ipc.new_stream(sink, tbl.schema) as w:
@@ -155,6 +156,18 @@ class ConnectServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        # AOT pre-warm: replay the served-plan history on a background
+        # worker so the plan space is traced/compiled (or loaded from
+        # the executable store) before the first client query arrives
+        try:
+            from spark_tpu import conf as _CF
+
+            svc = self.session.compile_service
+            if svc is not None and bool(
+                    self.session.conf.get(_CF.COMPILE_PREWARM_ENABLED)):
+                svc.prewarm(self.session, block=False)
+        except Exception:
+            pass  # pre-warm is an optimization, never a startup failure
         return self
 
     def stop(self) -> None:

@@ -26,6 +26,10 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
 - ``scheduler.admit``    the multi-tenant scheduler's HBM admission
                          decision (scheduler/scheduler.py), fired as a
                          query passes the device-admission gate
+- ``compile.background`` the background fused-compile job of the AOT
+                         compilation service (compile/service.py);
+                         a fired fault pins the plan to the chunked
+                         tier permanently (no swap, no crash)
 
 Spec grammar (the conf value):
 
@@ -76,6 +80,7 @@ POINTS = (
     "streaming.commit",
     "connect.request",
     "scheduler.admit",
+    "compile.background",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

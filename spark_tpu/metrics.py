@@ -167,6 +167,34 @@ def compile_cache_stats() -> Dict[str, int]:
         return dict(_COMPILE_CACHE)
 
 
+# ---- executable-store counters ----------------------------------------------
+
+#: cross-session executable store (spark_tpu/compile/) — hits/misses
+#: against the AOT store, serialize puts, LRU evictions, corrupt-entry
+#: evictions, background-compile chunk-first serves, hot swaps,
+#: permanent chunked fallbacks after background failure, and pre-warmed
+#: replays. Shown in tracing.warmup_profile and /api/v1/compile.
+_EXEC_STORE = {"hits": 0, "misses": 0, "puts": 0, "evictions": 0,
+               "corrupt": 0, "background": 0, "swaps": 0,
+               "fallbacks": 0, "prewarmed": 0}
+
+
+def note_exec_store(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _EXEC_STORE[kind] = _EXEC_STORE.get(kind, 0) + int(n)
+
+
+def exec_store_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_EXEC_STORE)
+
+
+def reset_exec_store() -> None:
+    with _LOCK:
+        for k in list(_EXEC_STORE):
+            _EXEC_STORE[k] = 0
+
+
 class PipelineStats:
     """Wall-time accounting for the out-of-HBM chunk pipeline
     (physical/pipeline.py): per-stage totals (decode / filter /

@@ -662,7 +662,14 @@ class MeshExecutor:
                 smapped = shard_map(local_fn, mesh=self.mesh,
                                     in_specs=_SPEC, out_specs=_SPEC,
                                     check_rep=False)
-            entry = (jax.jit(smapped), schema_box)
+            # cross-session executable store integration (no-op jit
+            # when the compile service is off)
+            from spark_tpu.compile import build_stage_callable
+
+            entry = (build_stage_callable(
+                "dist", plan, smapped,
+                tuple(s.sharded.data for s in scans), schema_box,
+                mesh_size=self.d, platform=key[2]), schema_box)
             _DIST_STAGE_CACHE[key] = entry
         jitted, schema_box = entry
         data = jitted(tuple(s.sharded.data for s in scans))

@@ -185,6 +185,34 @@ def _adaptive_snapshot(plan: P.PhysicalPlan) -> tuple:
     return tuple(out)
 
 
+def _stable_adaptive_snapshot(plan: P.PhysicalPlan) -> tuple:
+    """_adaptive_snapshot for the cross-session executable store:
+    identical structure, but the embedded index/table scan identities
+    use the store's content-digest keys instead of plan_key() (whose
+    hash(dicts) component is salted per process). Only computed on the
+    fresh-stage-entry path."""
+    from spark_tpu.compile.store import stable_plan_key
+
+    out = []
+
+    def go(p: P.PhysicalPlan) -> None:
+        if isinstance(p, P.JoinExec):
+            out.append((p.adaptive, p.index_orient,
+                        None if p.index_scan is None
+                        else stable_plan_key(p.index_scan),
+                        None if p.table_scan is None
+                        else stable_plan_key(p.table_scan)))
+        elif isinstance(p, (P.HashAggregateExec, P.GenerateExec)):
+            out.append(p.adaptive)
+        elif isinstance(p, P.CompactExec):
+            out.append(("compact", p.cap))
+        for c in p.children():
+            go(c)
+
+    go(plan)
+    return tuple(out)
+
+
 def _run_fused(plan: P.PhysicalPlan) -> Batch:
     """Compile a maximal traceable subtree to one XLA program and run it.
     The jit cache is keyed on plan structure + leaf shapes/dictionaries
@@ -213,7 +241,15 @@ def _run_fused(plan: P.PhysicalPlan) -> Batch:
             schema_box["schema"] = batch.schema
             return batch.data
 
-        entry = (jax.jit(stage_fn), schema_box)
+        # the stored callable consults the cross-session executable
+        # store when the compile service is active; otherwise this is
+        # exactly jax.jit(stage_fn)
+        from spark_tpu.compile import build_stage_callable
+
+        entry = (build_stage_callable(
+            "fused", plan, stage_fn,
+            tuple(s.batch.data for s in scans), schema_box,
+            extra=_stable_adaptive_snapshot(plan)), schema_box)
         _STAGE_CACHE[key] = entry
     jitted, schema_box = entry
     if fresh:

@@ -242,6 +242,26 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
         f"to the {floor}-byte floor (last: {last!r})") from last
 
 
+def plan_chunk_first(lp, conf, budget_bytes: int):
+    """Plan a forced chunked-tier execution for the background-compile
+    path (spark_tpu/compile/service): shrink the device-batch budget on
+    a shadow conf so ``find_chunkable`` fires even for plans that fit
+    HBM, returning ``(found, shadow_conf)`` ready for
+    ``execute_chunked``, or ``(None, None)`` when the plan has no
+    chunkable shape. The shadow never leaks into the session conf —
+    same idiom as the OOM ladder above."""
+    from spark_tpu.conf import RuntimeConf
+    from spark_tpu.physical.chunked import (MAX_DEVICE_BATCH_BYTES,
+                                            find_chunkable)
+
+    shadow = RuntimeConf(dict(conf._overrides))
+    shadow.set(MAX_DEVICE_BATCH_BYTES.key, max(1, int(budget_bytes)))
+    found = find_chunkable(lp, shadow)
+    if found is None:
+        return None, None
+    return found, shadow
+
+
 def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
     """Run ``fn`` (a stage/query execution thunk), retrying transient
     environment failures up to spark.stage.maxConsecutiveAttempts times.

@@ -69,6 +69,16 @@ def measured_plan_bytes(plan):
         return _MEASURED.get(key)
 
 
+def measured_snapshot() -> dict:
+    """Size/total of the measured-footprint table — after a pre-warm
+    replay (compile/service) this is populated before the first client
+    query, so admission decisions start from measured bytes instead of
+    static estimates; the compile service surfaces it in status()."""
+    with _MEASURED_LOCK:
+        return {"plans": len(_MEASURED),
+                "max_bytes": max(_MEASURED.values(), default=0)}
+
+
 def estimate_plan_bytes(plan, conf) -> int:
     """Estimated device footprint of executing ``plan``: a MEASURED
     peak stage footprint from a prior run of the same plan shape when

@@ -225,14 +225,21 @@ class QueryScheduler:
 
     def submit_query(self, build_df: Callable[[], Any], *,
                      pool: Optional[str] = None, description: str = "",
-                     deadline_s: Optional[float] = None) -> QueryTicket:
+                     deadline_s: Optional[float] = None,
+                     sql: Optional[str] = None) -> QueryTicket:
         """Engine-query convenience: ``build_df()`` -> DataFrame is the
         host-side parse/plan stage (its footprint is then estimated
-        from the logical plan); the device stage materializes Arrow."""
+        from the logical plan); the device stage materializes Arrow.
+        ``sql`` is the raw statement when the caller has one (the
+        connect server does): it rides on the DataFrame so the compile
+        service's served-plan history records a replayable identity
+        even for frames not built via session.sql."""
         holder: dict = {}
 
         def prepare(t: QueryTicket):
             df = build_df()
+            if sql is not None and getattr(df, "_sql_text", None) is None:
+                df._sql_text = sql
             holder["df"] = df
             conf = df._session.conf if df._session is not None \
                 else self._conf

@@ -386,6 +386,10 @@ def warmup_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
                 out["transfer"]["total_ms"]
                 + float(e.get("transfer_ms", 0.0)), 3)
     out["compile_cache"] = metrics.compile_cache_stats()
+    # cross-session executable store + background compile/hot-swap
+    # counters (spark_tpu/compile/): hit/miss/background/swap say
+    # whether warmup was skipped, hidden, or paid
+    out["executable_store"] = metrics.exec_store_stats()
     return out
 
 
@@ -401,6 +405,15 @@ def format_warmup_profile(profile: Optional[Dict[str, dict]] = None) -> str:
         f"persistent compile cache: {cc.get('hits', 0)} hits / "
         f"{cc.get('misses', 0)} misses",
     ]
+    es = p.get("executable_store")
+    if es:
+        lines.append(
+            f"executable store: {es.get('hits', 0)} hits / "
+            f"{es.get('misses', 0)} misses, {es.get('puts', 0)} puts, "
+            f"{es.get('background', 0)} background serves, "
+            f"{es.get('swaps', 0)} swaps, "
+            f"{es.get('fallbacks', 0)} fallbacks, "
+            f"{es.get('prewarmed', 0)} prewarmed")
     return "\n".join(lines)
 
 

@@ -13,6 +13,10 @@ Endpoints:
   /api/v1/storage       HBM store occupancy, counters, entry listing
   /api/v1/exchange      shuffle stats: rows/bytes/padding per exchange,
                         adaptive (AQE) decisions, exchange.* gauges
+  /api/v1/compile       AOT compilation service: executable-store
+                        hit/miss/put/evict counters, background
+                        compile + hot-swap state, pre-warm report,
+                        warmup profile, compile.* gauges
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -154,6 +158,22 @@ class _Handler(BaseHTTPRequestHandler):
                 "profile": tracing.exchange_profile(events),
                 "gauges": {k: v for k, v in metrics.gauges().items()
                            if k.startswith("exchange.")},
+            })
+        elif url.path == "/api/v1/compile":
+            from spark_tpu import tracing
+
+            session = getattr(self.server, "spark_session", None)
+            svc = None
+            try:
+                svc = session.compile_service if session else None
+            except Exception:
+                pass
+            self._json({
+                "service": svc.status() if svc is not None else None,
+                "exec_store": metrics.exec_store_stats(),
+                "warmup": tracing.warmup_profile(events),
+                "gauges": {k: v for k, v in metrics.gauges().items()
+                           if k.startswith("compile.")},
             })
         elif url.path == "/api/v1/storage":
             session = getattr(self.server, "spark_session", None)

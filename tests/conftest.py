@@ -79,9 +79,20 @@ def pytest_configure(config):
         "aqe: adaptive query execution over the mesh — runtime "
         "shuffle stats, capacity re-planning, broadcast switching, "
         "skew splitting")
+    config.addinivalue_line(
+        "markers",
+        "compile: AOT compilation service tests (spark_tpu/compile/) — "
+        "executable store, background compile + hot-swap, pre-warm")
 
 
 def pytest_collection_modifyitems(config, items):
+    # compile tests join daemon background-compile threads; every one
+    # gets the SIGALRM deadlock guard so a wedged join fails instead of
+    # hanging tier-1 (tests may still carry their own tighter timeout)
+    for item in items:
+        if "compile" in item.keywords and \
+                item.get_closest_marker("timeout") is None:
+            item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):
         return
     skip = pytest.mark.skip(reason="slow: run with --runslow")
