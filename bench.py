@@ -577,6 +577,16 @@ def main():
                    "adaptive": adaptive,
                    "robustness": _robustness_counters()})
 
+    analysis_overhead = None
+    if os.environ.get("BENCH_ANALYSIS", "1") == "1":
+        print("[bench] analyzer overhead: host-side static analysis "
+              "of the full 22-query suite", file=sys.stderr, flush=True)
+        try:
+            qnums = sorted(QUERIES) if FULL else (1, 3, 5)
+            analysis_overhead = _analysis_overhead(spark, qnums)
+        except Exception as e:
+            analysis_overhead = {"error": f"{type(e).__name__}: {e}"}
+
     serving = None
     if args.concurrency > 0:
         if _wall_remaining() <= 5:
@@ -631,6 +641,8 @@ def main():
         **({"cached": cached} if cached is not None else {}),
         **({"adaptive": adaptive} if adaptive is not None else {}),
         **({"serving": serving} if serving is not None else {}),
+        **({"analysis": analysis_overhead}
+           if analysis_overhead is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}
            if full else {}),
     }
@@ -738,12 +750,47 @@ def _run_adaptive_compare(spark) -> dict:
     return out
 
 
+def _analysis_overhead(spark, qnums) -> dict:
+    """Per-query static-analyzer overhead (spark_tpu/analysis/):
+    builds each query lazily and times analysis.analyze() — host-side
+    plan walking only, nothing executes, nothing compiles. This is the
+    cost the spark.tpu.analysis.level submit gate would add per query;
+    it should be low single-digit ms against multi-second queries."""
+    from spark_tpu import analysis
+    from spark_tpu.tpch.queries import QUERIES
+
+    out = {}
+    for q in sorted(qnums):
+        try:
+            df = spark.sql(QUERIES[q])
+            t0 = time.perf_counter()
+            report = analysis.analyze(df._plan, spark.conf)
+            ms = (time.perf_counter() - t0) * 1e3
+            out[str(q)] = {
+                "ms": round(ms, 2),
+                "diagnostics": len(report.diagnostics),
+                "errors": len(report.errors()),
+                "fingerprint_stable": report.fingerprint_stable,
+            }
+        except Exception as e:
+            out[str(q)] = {"error": f"{type(e).__name__}: {e}"}
+    ok = [v["ms"] for v in out.values() if "ms" in v]
+    out["total_ms"] = round(sum(ok), 2)
+    out["max_ms"] = round(max(ok), 2) if ok else 0.0
+    return out
+
+
 def _run_headline(spark, qnum: int) -> dict:
+    from spark_tpu import analysis
     from spark_tpu.plan.optimizer import optimize
     from spark_tpu.plan.subquery import rewrite_subqueries
     from spark_tpu.tpch.queries import QUERIES
 
     df = spark.sql(QUERIES[qnum])
+    # static-analyzer overhead for THIS query (host-side, no execution)
+    t0 = time.perf_counter()
+    analysis.analyze(df._plan, spark.conf)
+    analysis_ms = (time.perf_counter() - t0) * 1e3
     lp = optimize(rewrite_subqueries(df._plan))
     nbytes = _query_bytes(lp, spark.conf)
 
@@ -797,6 +844,7 @@ def _run_headline(spark, qnum: int) -> dict:
     return {
         "ms": round(ms, 1),
         "min_ms": round(min(times), 1),
+        "analysis_ms": round(analysis_ms, 2),
         "warmup_s": round(warm_s, 1),
         "rows": len(rows),
         "scan_gb": round(nbytes / 1e9, 3),

@@ -1,0 +1,27 @@
+"""Pre-execution static plan analysis (the "plan sanitizer").
+
+Public surface::
+
+    from spark_tpu import analysis
+
+    report = analysis.analyze(df._plan, spark.conf)   # never raises
+    print(report.format())
+
+    analysis.maybe_gate(plan, conf)   # spark.tpu.analysis.level gate
+
+Diagnostic codes are documented in analysis/diagnostics.py; the shared
+transform-legality rules (also used by the AQE skew fan, incremental
+merges, and the chunked tier) live in analysis/legality.py.
+"""
+
+from spark_tpu.analysis.analyzer import (analyze, maybe_gate,
+                                         recent_reports)
+from spark_tpu.analysis.diagnostics import (AnalysisReport, Diagnostic,
+                                            PlanAnalysisError)
+from spark_tpu.analysis import legality, oracle, hazards  # noqa: F401
+
+__all__ = [
+    "analyze", "maybe_gate", "recent_reports",
+    "AnalysisReport", "Diagnostic", "PlanAnalysisError",
+    "legality", "oracle", "hazards",
+]

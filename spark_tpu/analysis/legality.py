@@ -1,0 +1,190 @@
+"""Transform-legality rules: one shared decision procedure for every
+place the engine asks "may I re-apply / decompose this aggregate
+without changing bytes?".
+
+Before this module the answer lived in three ad-hoc spots with subtly
+different phrasing: the AQE skew fan
+(parallel/executor._exactly_remergeable), the accumulator decomposition
+(plan/incremental.AggSpec._add), and the chunked tier's
+try-AggSpec-except gate (physical/chunked._find_agg). They now all
+call here, and the static analyzer reports the same verdicts — with
+diagnostic codes — before anything executes.
+
+Two distinct legality questions:
+
+- **exact re-merge** (``remerge_verdict*``): can the aggregate list be
+  re-applied to its OWN output byte-identically? Required by the AQE
+  skew split (a pre-merge replica runs the consumer aggregate twice)
+  and by incremental materialized-view merges. Group keys pass
+  through; only Sum/Min/Max over a single column qualify; Sum must be
+  integral (int wraparound is associative, float rounding is not);
+  Min/Max must be non-float (-0.0/NaN selection is order-dependent).
+
+- **mergeable accumulators** (``accumulator_verdict``): can the
+  aggregate be decomposed into partial accumulators that a second
+  ordinary aggregation merges (count/sum/avg/min/max, no DISTINCT)?
+  Required by the chunked out-of-HBM tier and streaming state merge.
+  This is purely structural — merging partials happens exactly once,
+  so float Sum is fine here (same additions, same order class).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_tpu.expr import expressions as E
+
+
+@dataclass(frozen=True)
+class Verdict:
+    ok: bool
+    code: str = ""        # diagnostic code when not ok
+    reason: str = ""
+    offending: str = ""   # offending expression, printable
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+
+OK = Verdict(True)
+
+
+def _np_dtype(dtype) -> "np.dtype":
+    """numpy dtype of an engine DataType as the executor sees it
+    (StringType = int32 dictionary codes, DecimalType = scaled int64,
+    so both are exactly re-mergeable)."""
+    from spark_tpu.expr.compiler import _jnp_dtype
+
+    return np.dtype(_jnp_dtype(dtype))
+
+
+def _merge_dtype_verdict(call: E.Expression, dt: "np.dtype") -> Verdict:
+    """Numeric half of the exact-re-merge rule for one Sum/Min/Max call
+    whose merged accumulator has numpy dtype ``dt``."""
+    if isinstance(call, E.Sum):
+        if not (np.issubdtype(dt, np.integer) or dt == np.bool_):
+            return Verdict(
+                False, "PLAN-MERGE-FLOATSUM",
+                "float Sum re-merge changes rounding (float addition "
+                "is not associative); results would not be "
+                "byte-identical", str(call))
+        return OK
+    if np.issubdtype(dt, np.floating):
+        return Verdict(
+            False, "PLAN-MERGE-NONMERGEABLE",
+            "float Min/Max re-merge is order-dependent (-0.0 vs 0.0 "
+            "and NaN selection)", str(call))
+    return OK
+
+
+def remerge_verdict_cols(aggregates, schema) -> Verdict:
+    """Exact re-merge legality over an ALREADY-PARTIAL output schema:
+    every aggregate must be a group key (plain Col) or Sum/Min/Max over
+    a single column of ``schema`` with a re-mergeable dtype. This is
+    the AQE skew fan's precondition (the pre-merge replica re-applies
+    the consumer's aggregate list to its own output)."""
+    by_name = {f.name: f for f in schema.fields}
+    for a in aggregates:
+        e = E.strip_alias(a)
+        if isinstance(e, E.Col):  # group key carried through
+            continue
+        if not isinstance(e, (E.Sum, E.Min, E.Max)):
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                f"{type(e).__name__} is not exactly re-mergeable "
+                "(only integral Sum and non-float Min/Max re-apply "
+                "byte-identically)", str(e))
+        kids = e.children()
+        if len(kids) != 1 or not isinstance(kids[0], E.Col):
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                "re-merge argument must be a single plain column "
+                "(computed arguments would be re-evaluated over "
+                "already-aggregated rows)", str(e))
+        f = by_name.get(kids[0].name)
+        if f is None:
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                f"column {kids[0].name!r} absent from the merge "
+                "schema", str(e))
+        try:
+            dt = _np_dtype(f.dtype)
+        except Exception:
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                f"no numeric device dtype for {f.dtype}", str(e))
+        v = _merge_dtype_verdict(e, dt)
+        if not v.ok:
+            return v
+    return OK
+
+
+def remerge_verdict(agg) -> Verdict:
+    """Static (logical-plan) variant of the exact re-merge rule: the
+    same dtype discipline applied to a logical Aggregate before any
+    partial output exists — each aggregate call's MERGED accumulator
+    dtype (its own output dtype over the child schema) must satisfy
+    the Sum/Min/Max rules. Group keys and plain column pass-throughs
+    are fine; anything else is not exactly re-mergeable."""
+    schema = agg.child.schema
+    for a in agg.aggregates:
+        e = E.strip_alias(a)
+        if isinstance(e, E.Col):
+            continue
+        calls = E.collect_aggregates(e)
+        if not calls or E.expr_key(e) != E.expr_key(calls[0]) \
+                or len(calls) != 1:
+            # composite output expression (avg = sum/count, arithmetic
+            # over aggregates): re-applying it to its own output is
+            # not the identity merge
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                "composite aggregate output is not exactly "
+                "re-mergeable", str(e))
+        call = calls[0]
+        if not isinstance(call, (E.Sum, E.Min, E.Max)):
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                f"{type(call).__name__} is not exactly re-mergeable",
+                str(call))
+        try:
+            dt = _np_dtype(call.data_type(schema))
+        except Exception:
+            return Verdict(
+                False, "PLAN-MERGE-NONMERGEABLE",
+                "cannot resolve the merged accumulator dtype",
+                str(call))
+        v = _merge_dtype_verdict(call, dt)
+        if not v.ok:
+            return v
+    return OK
+
+
+def accumulator_verdict(call: E.Expression) -> Verdict:
+    """Mergeable-accumulator legality for ONE aggregate call (the
+    AggSpec decomposition gate): count/sum/avg/min/max without
+    DISTINCT. Structural only — partials merge exactly once, so float
+    Sum is legal here."""
+    if getattr(call, "distinct", False):
+        return Verdict(
+            False, "PLAN-ACC-NONMERGEABLE",
+            "DISTINCT aggregates are not mergeable accumulators",
+            str(call))
+    if not isinstance(call, (E.Count, E.Sum, E.Avg, E.Min, E.Max)):
+        return Verdict(
+            False, "PLAN-ACC-NONMERGEABLE",
+            f"aggregate {call} is not a mergeable accumulator",
+            str(call))
+    return OK
+
+
+def accumulators_verdict(aggregates) -> Verdict:
+    """Mergeable-accumulator legality over a whole aggregate list."""
+    for e in aggregates:
+        for call in E.collect_aggregates(e):
+            v = accumulator_verdict(call)
+            if not v.ok:
+                return v
+    return OK

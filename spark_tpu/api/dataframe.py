@@ -49,10 +49,21 @@ class DataFrame:
     def sparkSession(self):
         return self._session
 
-    def explain(self, extended: bool = False) -> None:
+    def explain(self, extended: bool = False,
+                mode: Optional[str] = None) -> None:
         from spark_tpu.plan.optimizer import optimize
         from spark_tpu.physical.planner import plan_physical
 
+        if mode == "lint" or extended == "lint":
+            # static plan analysis without executing (reference:
+            # Dataset.explain(mode) ExplainMode, Dataset.scala:590 —
+            # "lint" is this engine's extra mode)
+            from spark_tpu import analysis
+
+            conf = self._session.conf if self._session is not None \
+                else None
+            print(analysis.analyze(self._plan, conf).format())
+            return
         print("== Logical Plan ==")
         print(self._plan.tree_string())
         opt = optimize(self._plan)
@@ -282,6 +293,12 @@ class DataFrame:
 
         if self._session is not None:
             self._session._ensure_active()
+            # submit-time static analysis gate: no-op at the default
+            # level=off; raises PlanAnalysisError at level=error when
+            # the plan carries error-level diagnostics
+            from spark_tpu.analysis import maybe_gate
+
+            maybe_gate(self._plan, self._session.conf)
         metrics.query_start(self._plan.node_string())
         ex = getattr(self._session, "mesh_executor", None) \
             if self._session is not None else None

@@ -359,7 +359,9 @@ class SparkSessionBuilder:
             if "[" in url:
                 inner = url[url.index("[") + 1:url.index("]")]
                 n = None if inner == "*" else int(inner)
-            self._conf["spark_tpu.mesh.devices"] = n if n is not None else -1
+            from spark_tpu import conf as CF
+
+            self._conf[CF.MESH_DEVICES.key] = n if n is not None else -1
         return self
 
     def config(self, key: str, value: Any) -> "SparkSessionBuilder":
@@ -407,7 +409,9 @@ class SparkSession:
         self._read = None
         self._mesh = None
         self._mesh_executor = None
-        n = self.conf.entries().get("spark_tpu.mesh.devices")
+        from spark_tpu import conf as CF
+
+        n = self.conf.get(CF.MESH_DEVICES)
         if n is not None:
             from spark_tpu.parallel.mesh import make_mesh
 

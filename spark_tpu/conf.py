@@ -22,12 +22,32 @@ class ConfigEntry:
 
 _REGISTRY: Dict[str, ConfigEntry] = {}
 
+#: registered free-form key prefixes (per-pool scheduler keys etc.):
+#: prefix -> doc. A key matching a registered prefix is considered
+#: declared even though each concrete suffix is user-chosen.
+_PREFIXES: Dict[str, str] = {}
+
 
 def register(key: str, default: Any, doc: str,
              value_type: Callable[[Any], Any] = lambda x: x) -> ConfigEntry:
     entry = ConfigEntry(key, default, doc, value_type)
     _REGISTRY[key] = entry
     return entry
+
+
+def register_prefix(prefix: str, doc: str) -> str:
+    """Declare a free-form key family (e.g. per-pool scheduler keys,
+    scanned by prefix). Returns the prefix so callers can keep using it
+    as a plain string constant."""
+    _PREFIXES[prefix] = doc
+    return prefix
+
+
+def is_registered(key: str) -> bool:
+    """True when ``key`` is a declared ConfigEntry or matches a
+    registered free-form prefix (the invariant tools/lint_invariants.py
+    enforces for every literal conf key in the tree)."""
+    return key in _REGISTRY or any(key.startswith(p) for p in _PREFIXES)
 
 
 # ---- core entries ----------------------------------------------------------
@@ -154,10 +174,13 @@ SCHEDULER_DEFAULT_POOL = register(
     "Pool a query lands in when the submit carries no pool name "
     "(reference: spark.scheduler.pool defaulting).", str)
 
-#: free-form per-pool keys (scanned by prefix, not registered):
+#: free-form per-pool keys (scanned by prefix):
 #:   spark.tpu.scheduler.pool.<name>.weight    (int, default 1)
 #:   spark.tpu.scheduler.pool.<name>.minShare  (int, default 0)
-SCHEDULER_POOL_PREFIX = "spark.tpu.scheduler.pool."
+SCHEDULER_POOL_PREFIX = register_prefix(
+    "spark.tpu.scheduler.pool.",
+    "Per-pool FAIR scheduling keys: "
+    "spark.tpu.scheduler.pool.<name>.{weight,minShare}.")
 
 # ---- HBM-resident columnar storage (spark_tpu/storage/) --------------------
 
@@ -325,6 +348,41 @@ COMPILE_PREWARM_WORKERS = register(
     "more overlaps XLA compiles of independent plans.", int)
 
 
+# ---- static plan analysis (spark_tpu/analysis/) ----------------------------
+
+ANALYSIS_LEVEL = register(
+    "spark.tpu.analysis.level", "off",
+    "Pre-execution static plan analysis gate: off (default, no "
+    "analysis on the submit path), warn (analyze every submitted plan "
+    "and record diagnostics as events/metrics), or error (additionally "
+    "raise PlanAnalysisError when an error-level diagnostic fires "
+    "before anything touches the device). The same level also governs "
+    "conf.set of undeclared keys: warn emits a warning, error raises.",
+    str)
+
+ANALYSIS_DIVERGENCE_FACTOR = register(
+    "spark.tpu.analysis.divergenceFactor", 16.0,
+    "The analyzer's static byte estimate is cross-checked against "
+    "AQE's measured-bytes table (scheduler/admission); when the two "
+    "disagree by more than this factor in either direction, the plan "
+    "gets a PLAN-EST-DIVERGE diagnostic — the cost model is lying to "
+    "admission control for this plan shape.", float)
+
+ANALYSIS_ERROR_CODES = register(
+    "spark.tpu.analysis.errorCodes", "",
+    "Comma-separated diagnostic codes escalated to error level at the "
+    "submit-time gate (e.g. 'PLAN-DTYPE-F64,PLAN-RECOMPILE-SHAPE'): a "
+    "deployment that must never bake data-dependent shapes into plans "
+    "can fail such queries at submit instead of discovering the "
+    "recompile storm in production.", str)
+
+MESH_DEVICES = register(
+    "spark_tpu.mesh.devices", None,
+    "SPMD mesh size requested via SparkSession.builder.master"
+    "('mesh[N]'); -1 = all visible devices, None/unset = single-device "
+    "execution.", lambda v: v if v is None else int(v))
+
+
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""
 
@@ -342,6 +400,24 @@ class RuntimeConf:
     def set(self, key: str, value: Any) -> None:
         if key in _REGISTRY:
             value = _REGISTRY[key].value_type(value)
+        elif not is_registered(key):
+            # an undeclared key silently no-ops every read path (get()
+            # raises on it) — surface the typo at the level the session
+            # asked for (satellite of the static-analysis gate)
+            level = str(self._overrides.get(
+                ANALYSIS_LEVEL.key, ANALYSIS_LEVEL.default)).lower()
+            if level == "error":
+                raise KeyError(
+                    f"unknown config key: {key} (not a registered "
+                    "ConfigEntry or prefix; set "
+                    "spark.tpu.analysis.level=warn to tolerate)")
+            if level == "warn":
+                import warnings
+
+                warnings.warn(
+                    f"conf.set of undeclared key {key!r}: not a "
+                    "registered ConfigEntry or prefix — reads of it "
+                    "will raise KeyError", stacklevel=2)
         self._overrides[key] = value
 
     def unset(self, key: str) -> None:

@@ -89,6 +89,28 @@ class ConnectServer:
                                json.dumps({"cancelled": ok}).encode(),
                                "application/json")
                     return
+                if self.path == "/lint":
+                    # static analysis of a SQL query WITHOUT executing
+                    # it: build the lazy DataFrame, analyze, return the
+                    # report as JSON (the remote twin of
+                    # df.explain(mode="lint"))
+                    n = int(self.headers.get("Content-Length", "0"))
+                    try:
+                        req = json.loads(self.rfile.read(n))
+                        from spark_tpu import analysis
+
+                        df = outer.session.sql(req["query"])
+                        report = analysis.analyze(
+                            df._plan, outer.session.conf,
+                            intent=req.get("intent"))
+                        body = json.dumps(report.to_dict()).encode()
+                        self._send(200, body, "application/json")
+                    except Exception as e:
+                        body = json.dumps(
+                            {"error": type(e).__name__,
+                             "message": str(e)}).encode()
+                        self._send(400, body, "application/json")
+                    return
                 if self.path not in ("/sql", "/plan"):
                     self._send(404, b"not found", "text/plain")
                     return

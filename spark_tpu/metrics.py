@@ -167,6 +167,45 @@ def compile_cache_stats() -> Dict[str, int]:
         return dict(_COMPILE_CACHE)
 
 
+# ---- static-analysis counters -----------------------------------------------
+
+#: pre-execution plan analyzer (spark_tpu/analysis/) — runs, total
+#: error/warning-level diagnostics produced, and plans rejected by the
+#: level=error submit gate. Shown in tracing.analysis_profile and
+#: /api/v1/lint.
+_ANALYSIS = {"runs": 0, "errors": 0, "warnings": 0, "gated": 0}
+
+
+def note_analysis(report) -> None:
+    """Fold one AnalysisReport into the counters and gauges; also logs
+    the run as an ``analysis`` event so it lands in the query mark."""
+    errs = len(report.errors())
+    warns = len(report.warnings())
+    with _LOCK:
+        _ANALYSIS["runs"] += 1
+        _ANALYSIS["errors"] += errs
+        _ANALYSIS["warnings"] += warns
+        _GAUGES["analysis.peak_bytes"] = int(report.peak_bytes)
+        _GAUGES["analysis.fingerprint_stable"] = \
+            bool(report.fingerprint_stable)
+        _GAUGES["analysis.elapsed_ms"] = round(report.elapsed_ms, 3)
+    record("analysis", plan=report.plan, errors=errs, warnings=warns,
+           diagnostics=len(report.diagnostics),
+           peak_bytes=int(report.peak_bytes),
+           fingerprint_stable=bool(report.fingerprint_stable),
+           elapsed_ms=round(report.elapsed_ms, 3))
+
+
+def note_analysis_gated() -> None:
+    with _LOCK:
+        _ANALYSIS["gated"] += 1
+
+
+def analysis_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_ANALYSIS)
+
+
 # ---- executable-store counters ----------------------------------------------
 
 #: cross-session executable store (spark_tpu/compile/) — hits/misses

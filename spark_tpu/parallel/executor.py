@@ -85,37 +85,14 @@ def _exactly_remergeable(consumer: "D.DistSortAggExec",
                          schema: Schema) -> bool:
     """True when the consumer's aggregate list can be re-applied to its
     own output byte-identically — the precondition for the skew fan's
-    pre-merge. AggSpec merges are structurally idempotent (merge
-    aliases == accumulator names), so the question is purely numeric:
-    integer Sum is associative under wraparound, Min/Max over
-    non-floats is order-free. Float Sum (rounding), float Min/Max
-    (-0.0/NaN select order), and anything else stays on the exact
-    single-merge path."""
-    by_name = {f.name: f for f in schema.fields}
-    from spark_tpu.expr.compiler import _jnp_dtype
+    pre-merge. The rule set (integer Sum associative under wraparound,
+    non-float Min/Max order-free, everything else illegal) is shared
+    with the static analyzer and incremental merges: see
+    analysis/legality.py."""
+    from spark_tpu.analysis import legality
 
-    for a in consumer.aggregates:
-        e = E.strip_alias(a)
-        if isinstance(e, E.Col):  # group key carried through
-            continue
-        if not isinstance(e, (E.Sum, E.Min, E.Max)):
-            return False
-        kids = e.children()
-        if len(kids) != 1 or not isinstance(kids[0], E.Col):
-            return False
-        f = by_name.get(kids[0].name)
-        if f is None:
-            return False
-        try:
-            dt = np.dtype(_jnp_dtype(f.dtype))
-        except Exception:
-            return False
-        if isinstance(e, E.Sum):
-            if not (np.issubdtype(dt, np.integer) or dt == np.bool_):
-                return False
-        elif np.issubdtype(dt, np.floating):
-            return False
-    return True
+    return bool(legality.remerge_verdict_cols(consumer.aggregates,
+                                              schema))
 
 
 @dataclass(eq=False)

@@ -908,10 +908,17 @@ def find_chunkable(plan: L.LogicalPlan, conf):
 
 
 def _find_agg(above, agg: L.Aggregate, budget: int):
+    # cheap structural pre-check via the shared legality rule set
+    # (analysis/legality.py) before paying for full AggSpec planning;
+    # AggSpec itself enforces the same verdicts
+    from spark_tpu.analysis import legality
+
+    if not legality.accumulators_verdict(agg.aggregates):
+        return None  # non-mergeable aggregate: execute directly
     try:
         AggSpec(agg.groupings, agg.aggregates)
     except NotImplementedError:
-        return None  # non-mergeable aggregate: execute directly
+        return None
     scans = L.collect_nodes(agg.child, L.UnresolvedScan)
     ests = []
     for s in scans:

@@ -80,9 +80,14 @@ class AggSpec:
         self.merges.append(E.Alias(merge, name))
 
     def _add(self, call: E.AggregateExpression) -> None:
-        if getattr(call, "distinct", False):
-            raise NotImplementedError(
-                "DISTINCT aggregates are not mergeable accumulators")
+        # shared legality rule set (analysis/legality.py): DISTINCT and
+        # non-Count/Sum/Avg/Min/Max calls cannot decompose into
+        # mergeable accumulators
+        from spark_tpu.analysis import legality
+
+        verdict = legality.accumulator_verdict(call)
+        if not verdict.ok:
+            raise NotImplementedError(verdict.reason)
         i = len(self.partials)
         k = E.expr_key(call)
         if isinstance(call, E.Count):

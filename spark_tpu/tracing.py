@@ -417,6 +417,43 @@ def format_warmup_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
+def analysis_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up static-analysis runs (spark_tpu/analysis/): per-plan
+    diagnostic counts and analyzer latency from ``analysis`` events,
+    plus the lifetime run/error/warning/gated counters."""
+    evs = events if events is not None else metrics.recent(4096)
+    out: Dict[str, dict] = {"runs": [], "totals": metrics.analysis_stats()}
+    for e in evs:
+        if e.get("kind") != "analysis":
+            continue
+        out["runs"].append({
+            "plan": e.get("plan"),
+            "errors": int(e.get("errors", 0)),
+            "warnings": int(e.get("warnings", 0)),
+            "diagnostics": int(e.get("diagnostics", 0)),
+            "fingerprint_stable": bool(e.get("fingerprint_stable",
+                                             True)),
+            "elapsed_ms": float(e.get("elapsed_ms", 0.0)),
+        })
+    return out
+
+
+def format_analysis_profile(
+        profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else analysis_profile()
+    t = p.get("totals", {})
+    lines = [
+        f"analyzer: {t.get('runs', 0)} runs, {t.get('errors', 0)} "
+        f"errors, {t.get('warnings', 0)} warnings, "
+        f"{t.get('gated', 0)} plans gated"]
+    for r in p.get("runs", [])[-8:]:
+        flag = "" if r["fingerprint_stable"] else "  [recompile-hazard]"
+        lines.append(
+            f"  {r['plan']}: {r['errors']}E/{r['warnings']}W "
+            f"({r['elapsed_ms']:.1f}ms){flag}")
+    return "\n".join(lines)
+
+
 class PlanningTracker:
     """Phase timing for the planning pipeline (reference:
     catalyst/QueryPlanningTracker.scala). Use as

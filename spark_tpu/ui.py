@@ -17,6 +17,9 @@ Endpoints:
                         hit/miss/put/evict counters, background
                         compile + hot-swap state, pre-warm report,
                         warmup profile, compile.* gauges
+  /api/v1/lint          static plan analysis: recent AnalysisReports,
+                        run/error/warning/gated counters, analysis.*
+                        gauges
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -174,6 +177,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "warmup": tracing.warmup_profile(events),
                 "gauges": {k: v for k, v in metrics.gauges().items()
                            if k.startswith("compile.")},
+            })
+        elif url.path == "/api/v1/lint":
+            from spark_tpu import tracing
+            from spark_tpu.analysis import recent_reports
+
+            self._json({
+                "profile": tracing.analysis_profile(events),
+                "recent": [r.to_dict() for r in recent_reports()],
+                "gauges": {k: v for k, v in metrics.gauges().items()
+                           if k.startswith("analysis.")},
             })
         elif url.path == "/api/v1/storage":
             session = getattr(self.server, "spark_session", None)

@@ -83,6 +83,10 @@ def pytest_configure(config):
         "markers",
         "compile: AOT compilation service tests (spark_tpu/compile/) — "
         "executable store, background compile + hot-swap, pre-warm")
+    config.addinivalue_line(
+        "markers",
+        "analysis: static plan analysis — shape/dtype/capacity oracle, "
+        "recompilation hazards, transform legality, invariant linter")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,388 @@
+"""Static plan analysis (spark_tpu/analysis/) + the invariant linter
+(tools/lint_invariants.py).
+
+Coverage contract (the analyzer's acceptance bar):
+
+- every TPC-H query analyzes with ZERO error-level diagnostics — the
+  level=error submit gate must never reject a legitimate query (no
+  false positives),
+- seeded defects are each caught with their own distinct code:
+  data-dependent shape literal -> PLAN-RECOMPILE-SHAPE, float64 leak
+  -> PLAN-DTYPE-F64, float-Sum skew split -> PLAN-MERGE-FLOATSUM,
+- the shared legality rules agree with the executor/AggSpec behavior
+  they replaced,
+- conf.set of an unregistered key follows spark.tpu.analysis.level
+  (off: stored, warn: warning, error: KeyError),
+- the invariant linter is clean on this tree and each of its four
+  rules actually fires on a seeded violation.
+"""
+
+import ast
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import analysis
+from spark_tpu import conf as CF
+from spark_tpu.analysis import legality, oracle
+from spark_tpu.expr import expressions as E
+from spark_tpu.tpch.gen import generate_tables, register_views
+from spark_tpu.tpch.queries import QUERIES
+
+pytestmark = pytest.mark.analysis
+
+SF = 0.01
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+import lint_invariants  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tpch(spark):
+    tables = generate_tables(SF, seed=99)
+    register_views(spark, tables)
+    return spark
+
+
+@pytest.fixture()
+def analysis_conf(spark):
+    """Restore the analysis confs the test mutates."""
+    keys = (CF.ANALYSIS_LEVEL.key, CF.ANALYSIS_ERROR_CODES.key,
+            CF.ANALYSIS_DIVERGENCE_FACTOR.key)
+    try:
+        yield spark.conf
+    finally:
+        for k in keys:
+            spark.conf.unset(k)
+
+
+# ---- TPC-H: zero false positives at the error gate --------------------------
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_analyzes_with_zero_errors(tpch, qnum):
+    spark = tpch
+    df = spark.sql(QUERIES[qnum])  # lazy: nothing executes
+    report = analysis.analyze(df._plan, spark.conf)
+    assert report.node_count > 0
+    assert report.peak_bytes > 0
+    assert "PLAN-ANALYZE-FAIL" not in report.codes(), report.format()
+    assert not report.errors(), report.format()
+
+
+def test_tpch_error_gate_admits_all_queries(tpch, analysis_conf):
+    analysis_conf.set(CF.ANALYSIS_LEVEL.key, "error")
+    for qnum in sorted(QUERIES):
+        df = tpch.sql(QUERIES[qnum])
+        report = analysis.maybe_gate(df._plan, analysis_conf)
+        assert report is not None, f"q{qnum}: gate did not run"
+
+
+# ---- seeded defects: three distinct codes -----------------------------------
+
+
+def test_seeded_shape_literal_flagged(spark):
+    # a data-dependent row count baked into the plan SHAPE: every
+    # distinct bound re-traces and recompiles
+    df = spark.range(0, 12345)
+    report = analysis.analyze(df._plan, spark.conf)
+    assert "PLAN-RECOMPILE-SHAPE" in report.codes(), report.format()
+    assert not report.fingerprint_stable
+    d = next(d for d in report.diagnostics
+             if d.code == "PLAN-RECOMPILE-SHAPE")
+    assert "Range" in d.node  # names the offending node
+
+
+def test_seeded_f64_leak_flagged(spark):
+    # float64 literal widening integral arithmetic
+    df = spark.range(0, 64).selectExpr("id * 1.5 AS x")
+    report = analysis.analyze(df._plan, spark.conf)
+    assert "PLAN-DTYPE-F64" in report.codes(), report.format()
+
+
+def test_seeded_float_sum_skew_split_flagged(spark):
+    from spark_tpu.api import functions as F
+
+    pdf = pd.DataFrame({"k": np.arange(64) % 4,
+                        "v": np.linspace(0.0, 1.0, 64)})
+    df = spark.createDataFrame(pdf).groupBy("k").agg(F.sum("v"))
+    report = analysis.analyze(df._plan, spark.conf,
+                              intent="skew_split")
+    assert "PLAN-MERGE-FLOATSUM" in report.codes(), report.format()
+    # error-level BECAUSE the declared intent makes it fatal
+    assert any(d.code == "PLAN-MERGE-FLOATSUM" and d.level == "error"
+               for d in report.diagnostics)
+    # ...but merely executing the same plan is legitimate
+    relaxed = analysis.analyze(df._plan, spark.conf)
+    assert not relaxed.errors(), relaxed.format()
+
+
+def test_seeded_defect_codes_are_distinct():
+    codes = {"PLAN-RECOMPILE-SHAPE", "PLAN-DTYPE-F64",
+             "PLAN-MERGE-FLOATSUM"}
+    assert len(codes) == 3
+
+
+# ---- gate behavior ----------------------------------------------------------
+
+
+def test_gate_off_by_default(spark):
+    assert spark.conf.get(CF.ANALYSIS_LEVEL) == "off"
+    assert analysis.maybe_gate(spark.range(0, 8)._plan,
+                               spark.conf) is None
+
+
+def test_gate_error_codes_escalation_rejects_collect(spark,
+                                                     analysis_conf):
+    analysis_conf.set(CF.ANALYSIS_LEVEL.key, "error")
+    analysis_conf.set(CF.ANALYSIS_ERROR_CODES.key,
+                      "PLAN-RECOMPILE-SHAPE")
+    df = spark.range(0, 999)
+    with pytest.raises(analysis.PlanAnalysisError) as ei:
+        df.collect()
+    assert any(d.code == "PLAN-RECOMPILE-SHAPE" for d in ei.value.errors)
+    assert ei.value.report.node_count > 0
+    # same query at level=warn executes fine
+    analysis_conf.set(CF.ANALYSIS_LEVEL.key, "warn")
+    assert len(df.collect()) == 999
+
+
+def test_gate_records_metrics(spark, analysis_conf):
+    from spark_tpu import metrics
+
+    before = metrics.analysis_stats()
+    analysis.analyze(spark.range(0, 16)._plan, spark.conf)
+    after = metrics.analysis_stats()
+    assert after["runs"] == before["runs"] + 1
+    assert "analysis.elapsed_ms" in metrics.gauges()
+
+
+# ---- explain("lint") --------------------------------------------------------
+
+
+def test_explain_lint_mode(spark, capsys):
+    spark.range(0, 32).explain(mode="lint")
+    out = capsys.readouterr().out
+    assert "== Plan Analysis ==" in out
+    assert "PLAN-RECOMPILE-SHAPE" in out
+
+
+# ---- conf: unregistered keys follow the analysis level ----------------------
+
+
+def test_conf_set_unregistered_key_levels():
+    import warnings
+
+    conf = CF.RuntimeConf()
+    # off (default): stored silently, discoverable via entries()
+    conf.set("spark.tpu.bogus.key", "1")
+    assert conf.entries()["spark.tpu.bogus.key"] == "1"
+    conf = CF.RuntimeConf({CF.ANALYSIS_LEVEL.key: "warn"})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        conf.set("spark.tpu.bogus.key", "1")
+    assert any("spark.tpu.bogus.key" in str(x.message) for x in w)
+    conf = CF.RuntimeConf({CF.ANALYSIS_LEVEL.key: "error"})
+    with pytest.raises(KeyError):
+        conf.set("spark.tpu.bogus.key", "1")
+
+
+def test_conf_registered_prefix_admits_pool_keys():
+    conf = CF.RuntimeConf({CF.ANALYSIS_LEVEL.key: "error"})
+    # free-form per-pool keys match the registered prefix
+    conf.set("spark.tpu.scheduler.pool.etl.weight", "3")
+    assert conf.get("spark.tpu.scheduler.pool.etl.weight") == "3"
+
+
+# ---- shared legality rules agree with the paths they replaced ---------------
+
+
+def test_legality_matches_executor_remerge_rule(spark):
+    from spark_tpu.api import functions as F
+
+    pdf = pd.DataFrame({"k": np.arange(32) % 4,
+                        "i": np.arange(32),
+                        "f": np.linspace(0.0, 1.0, 32)})
+    base = spark.createDataFrame(pdf)
+    ok = base.groupBy("k").agg(F.sum("i"))._plan
+    bad = base.groupBy("k").agg(F.sum("f"))._plan
+    from spark_tpu.plan import logical as L
+
+    def agg_of(plan):
+        return next(n for n in [plan] + list(plan.children())
+                    if isinstance(n, L.Aggregate))
+
+    assert legality.remerge_verdict(agg_of(ok)).ok
+    v = legality.remerge_verdict(agg_of(bad))
+    assert not v.ok and v.code == "PLAN-MERGE-FLOATSUM"
+
+
+def test_legality_accumulator_verdicts():
+    v = legality.accumulator_verdict(E.Count(E.Col("x"), distinct=True))
+    assert not v.ok and v.code == "PLAN-ACC-NONMERGEABLE"
+    assert legality.accumulator_verdict(E.Sum(E.Col("x"))).ok
+    assert legality.accumulator_verdict(E.Avg(E.Col("x"))).ok
+
+
+def test_aggspec_uses_shared_rule():
+    from spark_tpu.plan.incremental import AggSpec
+
+    with pytest.raises(NotImplementedError, match="DISTINCT"):
+        AggSpec((E.Col("k"),),
+                (E.Alias(E.Count(E.Col("x"), distinct=True), "c"),))
+
+
+# ---- oracle internals -------------------------------------------------------
+
+
+def test_oracle_row_width_counts_validity_planes(spark):
+    df = spark.range(0, 8)  # single non-nullable int64 column
+    est = oracle.infer(df._plan, spark.conf)
+    assert est[-1].row_bytes == 8
+    assert est[-1].capacity >= 8
+    assert est[-1].device_bytes == est[-1].capacity * 8
+
+
+def test_oracle_capacity_bucket_rounding(spark):
+    multiple = int(spark.conf.get(CF.BATCH_CAPACITY_MULTIPLE))
+    est = oracle.infer(spark.range(0, multiple + 1)._plan, spark.conf)
+    assert est[-1].capacity == 2 * multiple
+
+
+def test_hazards_stable_plan(spark):
+    # a Relation scan with plain column projection has no literals and
+    # no shape-bearing scalars: fingerprint-stable
+    pdf = pd.DataFrame({"a": np.arange(16), "b": np.arange(16.0)})
+    df = spark.createDataFrame(pdf).select("a", "b")
+    report = analysis.analyze(df._plan, spark.conf)
+    assert report.fingerprint_stable, report.format()
+
+
+# ---- analyzer overhead ------------------------------------------------------
+
+
+def test_analyzer_overhead_under_50ms(tpch):
+    spark = tpch
+    df = spark.sql(QUERIES[1])
+    analysis.analyze(df._plan, spark.conf)  # warm imports off the clock
+    report = analysis.analyze(df._plan, spark.conf)
+    assert report.elapsed_ms < 50.0, \
+        f"analyzer took {report.elapsed_ms:.1f} ms on q1 at SF{SF}"
+
+
+# ---- HTTP surfaces ----------------------------------------------------------
+
+
+def test_api_v1_lint_endpoint(spark):
+    from spark_tpu.ui import StatusServer
+
+    analysis.analyze(spark.range(0, 8)._plan, spark.conf)
+    srv = StatusServer(session=spark, port=0)
+    try:
+        with urllib.request.urlopen(srv.url + "/api/v1/lint",
+                                    timeout=10) as r:
+            body = json.loads(r.read())
+    finally:
+        srv.stop()
+    assert body["profile"]["totals"]["runs"] >= 1
+    assert isinstance(body["recent"], list) and body["recent"]
+    assert "diagnostics" in body["recent"][-1]
+
+
+@pytest.mark.timeout(120)
+def test_connect_lint_endpoint(tpch):
+    from spark_tpu.connect.server import ConnectServer
+
+    srv = ConnectServer(tpch, port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://{srv.host}:{srv.port}/lint",
+            data=json.dumps(
+                {"query": "SELECT l_orderkey FROM lineitem "
+                          "WHERE l_quantity > 10"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+    finally:
+        srv.stop()
+    assert body["node_count"] > 0
+    assert body["errors"] == 0
+
+
+# ---- invariant linter -------------------------------------------------------
+
+
+def test_lint_invariants_clean_on_tree():
+    findings = lint_invariants.run_lint()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_lint_rule_conf_keys_fires():
+    tree = ast.parse("conf.get('spark.tpu.not.a.real.key')")
+    out = []
+    lint_invariants._check_conf_keys(
+        tree, "x.py", lint_invariants.DEFAULT_CONFIG, out)
+    assert len(out) == 1 and out[0].rule == "conf-keys"
+
+
+def test_lint_rule_fault_points_fires():
+    tree = ast.parse("faults.inject('bogus.point', conf)")
+    out = []
+    lint_invariants._check_fault_points(tree, "x.py", out)
+    assert len(out) == 1 and out[0].rule == "fault-points"
+    ok = []
+    lint_invariants._check_fault_points(
+        ast.parse("faults.inject('connect.request', conf)"), "x.py", ok)
+    assert ok == []
+
+
+def test_lint_rule_fingerprint_purity_fires():
+    src = (
+        "def stable_plan_key(d):\n"
+        "    a = hash(d)\n"
+        "    for k, v in d.items():\n"
+        "        pass\n"
+        "    for k in sorted(d.items()):\n"
+        "        pass\n"
+        "    return a\n")
+    out = []
+    lint_invariants._check_fingerprint_purity(
+        ast.parse(src), "x.py", [], out)
+    rules = [f.message for f in out]
+    assert len(out) == 2, rules  # hash() + unsorted .items(); NOT the
+    #                              sorted(...) one
+
+
+def test_lint_rule_metrics_lock_fires():
+    src = (
+        "import threading\n"
+        "_LOCK = threading.Lock()\n"
+        "_EVENTS = []\n"
+        "def bad(ev):\n"
+        "    _EVENTS.append(ev)\n"
+        "def good(ev):\n"
+        "    with _LOCK:\n"
+        "        _EVENTS.append(ev)\n")
+    out = []
+    lint_invariants._check_metrics_locks(
+        ast.parse(src), "x.py", lint_invariants.DEFAULT_CONFIG, out)
+    assert len(out) == 1 and out[0].line == 5
+
+
+def test_lint_cli_exits_zero():
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "lint_invariants.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
