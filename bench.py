@@ -407,6 +407,108 @@ def _run_serving(spark, concurrency: int, queries: dict,
     }
 
 
+def _run_serve_ab(spark, concurrency: int, replicas_n: int,
+                  rounds: int = 2) -> dict:
+    """Federation-tier A/B (spark_tpu/serve/): the same golden q1/q3/q5
+    mix driven over REAL HTTP through the FederationRouter, once with a
+    single replica and the result cache off (the pre-federation
+    serving path) and once with N replicas and the plan-keyed result
+    cache on. Every response is checked byte-identical against a
+    serial in-process reference — a QPS number from a cache that
+    serves stale or corrupted bytes would be worse than no number."""
+    import threading
+
+    from spark_tpu.connect.server import Client
+    from spark_tpu.serve import serve_fleet
+    from spark_tpu.tpch.queries import QUERIES
+
+    queries = {q: QUERIES[q] for q in (1, 3, 5)}
+    # serial reference (also the warm-up: compiles once, off the clock)
+    ref = {q: spark.sql(sql).toArrow() for q, sql in queries.items()}
+
+    def drive(n_replicas: int, cache_on: bool) -> dict:
+        spark.conf.set("spark.tpu.serve.resultCache.enabled", cache_on)
+        cache = getattr(spark, "serve_result_cache", None)
+        if cache is not None:
+            cache.clear()  # each arm starts cold
+        fleet = serve_fleet(spark, replicas=n_replicas)
+        lock = threading.Lock()
+        latencies, mismatched, errors = [], [], []
+
+        def client(idx: int) -> None:
+            c = Client(fleet.url, timeout=QUERY_TIMEOUT_S)
+            for _ in range(rounds):
+                for qnum in sorted(queries):
+                    t0 = time.perf_counter()
+                    try:
+                        tbl = c.sql(queries[qnum])
+                    except Exception as e:
+                        with lock:
+                            errors.append(
+                                f"q{qnum}: {type(e).__name__}: {e}")
+                        continue
+                    lat_ms = (time.perf_counter() - t0) * 1e3
+                    ok = tbl.equals(ref[qnum])
+                    with lock:
+                        latencies.append(lat_ms)
+                        if not ok:
+                            mismatched.append(qnum)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+        fleet.stop()
+        total = len(latencies)
+        from spark_tpu import metrics as _metrics
+        return {
+            "replicas": n_replicas,
+            "cache": "on" if cache_on else "off",
+            "queries_completed": total,
+            "errors": errors[:10],
+            "wall_s": round(wall_s, 2),
+            "qps": round(total / wall_s, 2) if wall_s else 0.0,
+            "p50_ms": round(_percentile(latencies, 50), 1),
+            "p95_ms": round(_percentile(latencies, 95), 1),
+            "byte_identical_to_serial": not mismatched and not errors,
+            "mismatched_queries": sorted(set(mismatched)),
+            "serve_counters": _metrics.serve_stats(),
+        }
+
+    from spark_tpu import metrics as _metrics
+    out = {"concurrency": concurrency, "rounds": rounds}
+    try:
+        _metrics.reset_serve()
+        out["one_replica_cache_off"] = drive(1, False)
+        if _wall_remaining() <= 10:
+            out["fleet_cached"] = {
+                "error": "skipped: wall budget exhausted"}
+            return out
+        _metrics.reset_serve()
+        out["fleet_cached"] = drive(replicas_n, True)
+        base = out["one_replica_cache_off"]
+        fleet = out["fleet_cached"]
+        if base.get("qps") and fleet.get("qps"):
+            out["qps_speedup"] = round(fleet["qps"] / base["qps"], 2)
+        if fleet.get("p95_ms") and base.get("p95_ms"):
+            out["p95_reduction"] = round(
+                base["p95_ms"] / fleet["p95_ms"], 2)
+        out["byte_identical_to_serial"] = (
+            base.get("byte_identical_to_serial", False)
+            and fleet.get("byte_identical_to_serial", False))
+    finally:
+        spark.conf.unset("spark.tpu.serve.resultCache.enabled")
+        cache = getattr(spark, "serve_result_cache", None)
+        if cache is not None:
+            cache.clear()
+    return out
+
+
 def main():
     import argparse
 
@@ -428,6 +530,13 @@ def main():
         "--serving-rounds", type=int,
         default=int(os.environ.get("BENCH_SERVING_ROUNDS", "2")),
         help="mix replays per serving client")
+    ap.add_argument(
+        "--replicas", type=int,
+        default=int(os.environ.get("BENCH_REPLICAS", "0")),
+        help="N>0 adds the federation A/B (needs --concurrency): the "
+             "serving mix over real HTTP through the router, 1 replica "
+             "cache off vs N replicas with the plan-keyed result cache "
+             "on; qps/p50/p95 + byte-identity land under 'serve'")
     args = ap.parse_args()
 
     jax.config.update("jax_enable_x64", True)
@@ -608,6 +717,28 @@ def main():
                    "serving": serving,
                    "robustness": _robustness_counters()})
 
+    serve_ab = None
+    if args.replicas > 0 and args.concurrency > 0:
+        if _wall_remaining() <= 5:
+            serve_ab = {"error": "skipped: wall budget exhausted",
+                        "phase": "serve"}
+        else:
+            print(f"[bench] serve A/B: 1 replica cache off vs "
+                  f"{args.replicas} replicas cache on "
+                  f"({args.concurrency} clients over HTTP)",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_query_deadline()):
+                    serve_ab = _run_serve_ab(
+                        spark, args.concurrency, args.replicas,
+                        rounds=args.serving_rounds)
+            except Exception as e:
+                serve_ab = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "serve": serve_ab,
+                   "robustness": _robustness_counters()})
+
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
     # headline number stays meaningful (flagged via queries_failed)
@@ -641,6 +772,7 @@ def main():
         **({"cached": cached} if cached is not None else {}),
         **({"adaptive": adaptive} if adaptive is not None else {}),
         **({"serving": serving} if serving is not None else {}),
+        **({"serve": serve_ab} if serve_ab is not None else {}),
         **({"analysis": analysis_overhead}
            if analysis_overhead is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}

@@ -383,6 +383,46 @@ MESH_DEVICES = register(
     "execution.", lambda v: v if v is None else int(v))
 
 
+# ---- scale-out serving tier (spark_tpu/serve/) ----------------------------
+
+SERVE_POLICY = register(
+    "spark.tpu.serve.policy", "least_queued",
+    "Federation-router replica selection: 'round_robin' cycles "
+    "replicas, 'least_queued' picks the replica whose scheduler "
+    "reports the fewest queued+running queries at the last health "
+    "probe (reference analogue: spark.scheduler.mode for in-process "
+    "pools; this is its cross-replica sibling).", str)
+
+SERVE_RESULT_CACHE_ENABLED = register(
+    "spark.tpu.serve.resultCache.enabled", False,
+    "Serve repeated identical queries from the plan-keyed Arrow "
+    "result cache (serve/result_cache.py): keyed by the structural "
+    "plan key + scan-source mtime/size fingerprints, single-flight "
+    "per key, byte-identical to uncached execution.", bool)
+
+SERVE_RESULT_CACHE_MAX_BYTES = register(
+    "spark.tpu.serve.resultCache.maxBytes", 256 * 1024 * 1024,
+    "Byte bound for the serve-tier result cache; least-recently-used "
+    "entries are evicted past it and a single result larger than the "
+    "bound is served but never cached.", int)
+
+SERVE_DISPATCH_RETRIES = register(
+    "spark.tpu.serve.dispatchRetries", 3,
+    "How many times the federation router re-dispatches one request "
+    "to a different replica after a replica connection failure or an "
+    "injected serve.dispatch fault before surfacing the error.", int)
+
+SERVE_HEALTH_PROBE_SECONDS = register(
+    "spark.tpu.serve.healthProbeSeconds", 0.5,
+    "Minimum age of a replica's cached /health snapshot before the "
+    "router re-probes it; 0 probes on every dispatch (tests).", float)
+
+SERVE_REPLICAS = register(
+    "spark.tpu.serve.replicas", 2,
+    "Default replica count for serve_fleet() when the caller does not "
+    "pass one explicitly.", int)
+
+
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""
 

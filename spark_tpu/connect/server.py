@@ -18,10 +18,23 @@ from spark_tpu import faults
 
 class ConnectServer:
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat=None, scheduler=None):
+                 heartbeat=None, scheduler=None,
+                 replica_id: Optional[str] = None, result_cache=None):
         from spark_tpu.scheduler import QueryScheduler, SchedulerQueueFull
 
         self.session = session
+        #: serve-tier plan-keyed result cache, shared across every
+        #: in-process replica of this session so the single-flight
+        #: guarantee spans the fleet (active only when
+        #: spark.tpu.serve.resultCache.enabled)
+        if result_cache is None:
+            result_cache = getattr(session, "serve_result_cache", None)
+            if result_cache is None:
+                from spark_tpu.serve.result_cache import ResultCache
+
+                result_cache = ResultCache(session.conf)
+                session.serve_result_cache = result_cache
+        self.result_cache = result_cache
         #: optional recovery.HeartbeatMonitor surfaced via GET /health;
         #: falls back to one attached to the session
         self.heartbeat = heartbeat if heartbeat is not None \
@@ -65,10 +78,17 @@ class ConnectServer:
                     hb = outer.heartbeat
                     body = json.dumps(
                         {"status": "ok",
+                         "replica": outer.replica_id,
+                         # live load snapshot the federation router's
+                         # least_queued policy and shedding read
+                         "queue_depth": outer.scheduler.queue_depth(),
+                         "running": outer.scheduler.running_count(),
                          "heartbeat": hb.status() if hb is not None
                          else None,
                          "scheduler": outer.scheduler.status()}).encode()
-                    self._send(200, body, "application/json")
+                    self._send(
+                        200, body, "application/json",
+                        headers={"X-SparkTpu-Replica": outer.replica_id})
                 elif self.path.startswith("/queries"):
                     body = json.dumps(
                         {"status": outer.scheduler.status(),
@@ -135,13 +155,54 @@ class ConnectServer:
                     pool = req.get("pool") \
                         or self.headers.get("X-Spark-Pool")
                     deadline_s = req.get("deadline_s")
-                    ticket = outer.scheduler.submit_query(
-                        build_df, pool=pool,
-                        description=req.get("query",
-                                            f"plan:{self.path}"),
-                        deadline_s=float(deadline_s)
-                        if deadline_s is not None else None,
-                        sql=req.get("query"))
+                    description = req.get("query", f"plan:{self.path}")
+
+                    def submit(bdf):
+                        return outer.scheduler.submit_query(
+                            bdf, pool=pool, description=description,
+                            deadline_s=float(deadline_s)
+                            if deadline_s is not None else None,
+                            sql=req.get("query"))
+
+                    cache = outer.result_cache
+                    key = None
+                    if cache is not None and cache.enabled():
+                        # cache hook BEFORE submit_query: a hit (or a
+                        # piggyback on an identical in-flight query)
+                        # never touches the scheduler at all — the
+                        # dispatch+execution cost of a repeated
+                        # dashboard query is one dict lookup
+                        try:
+                            df = build_df()
+                            from spark_tpu.serve.result_cache import \
+                                plan_result_key
+
+                            key = plan_result_key(df._plan)
+                        except Exception:
+                            key = None  # unkeyable: uncached path
+                    if key is not None:
+                        holder = {}
+
+                        def execute():
+                            t = holder["ticket"] = submit(lambda: df)
+                            return t.result()
+
+                        blob, status = cache.get_or_execute(
+                            key, execute)
+                        headers = {
+                            "X-SparkTpu-Replica": outer.replica_id,
+                            "X-Cache": status}
+                        t = holder.get("ticket")
+                        if t is not None:
+                            headers["X-Query-Id"] = str(t.id)
+                            headers["X-Queue-Wait-Ms"] = \
+                                f"{t.queue_wait_ms():.2f}"
+                        self._send(
+                            200, blob,
+                            "application/vnd.apache.arrow.stream",
+                            headers=headers)
+                        return
+                    ticket = submit(build_df)
                     tbl = ticket.result()
                     sink = io.BytesIO()
                     with pa.ipc.new_stream(sink, tbl.schema) as w:
@@ -152,17 +213,23 @@ class ConnectServer:
                         headers={
                             "X-Query-Id": str(ticket.id),
                             "X-Queue-Wait-Ms":
-                                f"{ticket.queue_wait_ms():.2f}"})
+                                f"{ticket.queue_wait_ms():.2f}",
+                            "X-SparkTpu-Replica": outer.replica_id})
                 except SchedulerQueueFull as e:
                     # backpressure, not failure: the client should back
-                    # off and retry (Client honors Retry-After)
+                    # off and retry (Client honors Retry-After); the
+                    # federation router instead sheds the request to
+                    # the least-loaded healthy replica
                     body = json.dumps(
                         {"error": "SchedulerQueueFull",
                          "message": str(e),
                          "retry_after_s": e.retry_after_s}).encode()
                     self._send(429, body, "application/json",
-                               headers={"Retry-After":
-                                        f"{e.retry_after_s:g}"})
+                               headers={
+                                   "Retry-After":
+                                       f"{e.retry_after_s:g}",
+                                   "X-SparkTpu-Replica":
+                                       outer.replica_id})
                 except Exception as e:  # error -> JSON with message
                     body = json.dumps(
                         {"error": type(e).__name__,
@@ -172,6 +239,9 @@ class ConnectServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
+        #: stable identity the federation router routes affinity by;
+        #: defaults to the bound port (unique per in-process fleet)
+        self.replica_id = replica_id or f"r{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "ConnectServer":
@@ -226,10 +296,21 @@ class Client:
 
     Transient connection errors (refused/reset — a flapping or
     restarting server) and 429 backpressure responses are retried with
-    bounded exponential backoff; a 429's Retry-After header is honored
-    as the floor for the next delay. Timeouts and real query errors
+    FULL-JITTER bounded exponential backoff (delay drawn uniformly
+    from [0, min(cap, base * 2^attempt)]): N clients rejected by the
+    same full queue would otherwise all sleep the identical
+    deterministic delay and stampede the queue again the moment it
+    expires. A 429's Retry-After hint is still honored as an additive
+    floor — the jitter spreads arrivals across the window AFTER the
+    server said capacity may exist. Timeouts and real query errors
     are NOT retried — a deadline that passed once will pass again, and
-    retrying a genuine bug only quadruples its latency."""
+    retrying a genuine bug only quadruples its latency.
+
+    When the server echoes an ``X-SparkTpu-Replica`` header (a
+    federation router does, naming the replica that served the
+    request), the client sends it back on subsequent requests as
+    session affinity, keeping one client's queries on one replica's
+    warm scheduler/compile state."""
 
     def __init__(self, url: str, timeout: float = 60.0,
                  retries: int = 3, backoff_s: float = 0.05,
@@ -241,6 +322,16 @@ class Client:
         self.retries = max(0, int(retries))
         self.backoff_s = float(backoff_s)
         self.max_backoff_s = float(max_backoff_s)
+        #: replica affinity echoed by a federation router; None until
+        #: the first routed response
+        self.affinity: Optional[str] = None
+
+    def _jitter(self, attempt: int) -> float:
+        import random as _random
+
+        return _random.uniform(
+            0.0, min(self.max_backoff_s,
+                     self.backoff_s * (2.0 ** attempt)))
 
     def _post(self, path: str, payload: dict,
               pool: Optional[str] = None) -> pa.Table:
@@ -251,18 +342,18 @@ class Client:
             try:
                 return self._post_once(path, payload, pool)
             except _RetryableHTTP as e:
-                # 429 backpressure: wait at least the server's
-                # Retry-After hint (capped by max_backoff_s)
+                # 429 backpressure: the server's Retry-After hint is
+                # the floor, full jitter desynchronizes the herd above
+                # it
                 last = e
-                delay = max(self.backoff_s * (2.0 ** attempt),
-                            e.retry_after_s)
+                delay = e.retry_after_s + self._jitter(attempt)
             except (ConnectionRefusedError, ConnectionResetError,
                     ConnectionAbortedError, BrokenPipeError) as e:
                 last = e
-                delay = self.backoff_s * (2.0 ** attempt)
+                delay = self._jitter(attempt)
             if attempt >= self.retries:
                 break
-            _time.sleep(min(delay, self.max_backoff_s))
+            _time.sleep(delay)
         raise RuntimeError(
             f"connect request to {self.url + path} failed after "
             f"{self.retries + 1} attempts (last: {last!r})") from last
@@ -276,6 +367,8 @@ class Client:
         headers = {"Content-Type": "application/json"}
         if pool:
             headers["X-Spark-Pool"] = pool
+        if self.affinity:
+            headers["X-SparkTpu-Replica"] = self.affinity
         req = urllib.request.Request(
             self.url + path,
             data=json.dumps(payload).encode(), headers=headers)
@@ -283,6 +376,9 @@ class Client:
             with urllib.request.urlopen(req,
                                         timeout=self.timeout) as resp:
                 data = resp.read()
+                rid = resp.headers.get("X-SparkTpu-Replica")
+                if rid:
+                    self.affinity = rid
         except urllib.error.HTTPError as e:
             detail = json.loads(e.read())
             if e.code == 429:

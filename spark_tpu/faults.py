@@ -30,6 +30,13 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          compilation service (compile/service.py);
                          a fired fault pins the plan to the chunked
                          tier permanently (no swap, no crash)
+- ``serve.dispatch``     the federation router's forward of one request
+                         to a chosen replica (serve/federation.py) —
+                         a transient fault is a replica dying mid-query
+                         and triggers a bounded re-dispatch to a
+                         different replica; the single-flight result
+                         cache guarantees the query still executes at
+                         most once per structural key
 
 Spec grammar (the conf value):
 
@@ -81,6 +88,7 @@ POINTS = (
     "connect.request",
     "scheduler.admit",
     "compile.background",
+    "serve.dispatch",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

@@ -234,6 +234,36 @@ def reset_exec_store() -> None:
             _EXEC_STORE[k] = 0
 
 
+# ---- serving-tier counters --------------------------------------------------
+
+#: federation router + plan-keyed result cache (spark_tpu/serve/) —
+#: result-cache hits/misses, single-flight waits that piggybacked on an
+#: in-flight execution, router dispatches, queue-full sheds to another
+#: replica, re-dispatches after a replica death, all-replicas-saturated
+#: rejections (the only case a client still sees a 429), and replica
+#: connection failures. Shown in tracing.serve_profile and
+#: /api/v1/serve.
+_SERVE = {"hits": 0, "misses": 0, "waits": 0, "dispatches": 0,
+          "sheds": 0, "redispatches": 0, "rejected": 0,
+          "replica_failures": 0}
+
+
+def note_serve(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _SERVE[kind] = _SERVE.get(kind, 0) + int(n)
+
+
+def serve_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_SERVE)
+
+
+def reset_serve() -> None:
+    with _LOCK:
+        for k in list(_SERVE):
+            _SERVE[k] = 0
+
+
 class PipelineStats:
     """Wall-time accounting for the out-of-HBM chunk pipeline
     (physical/pipeline.py): per-stage totals (decode / filter /

@@ -161,7 +161,8 @@ class QueryScheduler:
             raise ValueError(
                 f"spark.scheduler.mode must be FIFO or FAIR, got "
                 f"{self.mode!r}")
-        self.queue_depth = max(0, int(conf.get(CF.SCHEDULER_QUEUE_DEPTH)))
+        self.max_queue_depth = max(
+            0, int(conf.get(CF.SCHEDULER_QUEUE_DEPTH)))
         self.retry_after_s = float(conf.get(CF.SCHEDULER_RETRY_AFTER))
         self.pools = PoolRegistry(conf)
         # share the session's unified storage/execution memory manager
@@ -202,7 +203,7 @@ class QueryScheduler:
         with self._cond:
             if self._stopped:
                 raise RuntimeError("scheduler is stopped")
-            if self._queued >= self.queue_depth:
+            if self._queued >= self.max_queue_depth:
                 self.rejected += 1
                 metrics.record("scheduler", phase="rejected",
                                pool=p.name, queued=self._queued)
@@ -274,11 +275,24 @@ class QueryScheduler:
 
     # -- introspection -------------------------------------------------------
 
+    def queue_depth(self) -> int:
+        """Live queued-query count (NOT the configured bound) under the
+        scheduler lock — the federation router's load signal."""
+        with self._cond:
+            return self._queued
+
+    def running_count(self) -> int:
+        """Queries past the queue (ADMITTED at the device gate or
+        RUNNING on a worker) right now, under the scheduler lock."""
+        with self._cond:
+            return sum(1 for t in self._recent
+                       if t.state in (ADMITTED, RUNNING))
+
     def status(self) -> Dict[str, Any]:
         with self._cond:
             return {
                 "mode": self.mode,
-                "queue_depth": self.queue_depth,
+                "queue_depth": self.max_queue_depth,
                 "queued": self._queued,
                 "gate_waiters": len(self._gate),
                 "rejected": self.rejected,

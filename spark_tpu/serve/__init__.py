@@ -1,0 +1,38 @@
+"""Scale-out serving tier (ROADMAP item 4): replica federation router
++ plan-keyed result cache + cross-replica admission shedding.
+
+Three pieces:
+
+- ``router.FederationRouter`` — an HTTP front end speaking the connect
+  protocol over N ConnectServer replicas (``serve_fleet`` spawns an
+  in-process fleet over one session; production runs one replica
+  process per host and hands the router their URLs).
+- ``result_cache.ResultCache`` — Arrow-IPC results keyed by the
+  structural plan key + scan-source freshness fingerprints, bounded by
+  ``spark.tpu.serve.resultCache.maxBytes``, single-flight per key.
+- ``federation.Federation`` — health probing, routing policy
+  (``spark.tpu.serve.policy``), 429 shedding to the least-loaded
+  replica, and bounded re-dispatch around replica death (fault point
+  ``serve.dispatch``).
+"""
+
+from spark_tpu.serve.federation import (Federation, NoHealthyReplica,
+                                        Replica)
+from spark_tpu.serve.result_cache import (ResultCache, ipc_to_table,
+                                          plan_result_key,
+                                          table_to_ipc)
+from spark_tpu.serve.router import (FederationRouter, Fleet,
+                                    serve_fleet)
+
+__all__ = [
+    "Federation",
+    "FederationRouter",
+    "Fleet",
+    "NoHealthyReplica",
+    "Replica",
+    "ResultCache",
+    "ipc_to_table",
+    "plan_result_key",
+    "serve_fleet",
+    "table_to_ipc",
+]

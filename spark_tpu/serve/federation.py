@@ -1,0 +1,302 @@
+"""Cross-replica dispatch: health probing, policy pick, admission
+shedding, and bounded re-dispatch on replica death.
+
+The router's brain. Each replica is a ConnectServer (in-process thread
+or separate process — only its URL matters here) whose ``/health``
+reports ``replica`` id, live ``queue_depth`` and ``running`` count
+(scheduler/scheduler.py snapshots under its own lock). Dispatch:
+
+- **pick** honors session affinity first (the ``X-SparkTpu-Replica``
+  header a client echoes back), then the configured policy
+  (``spark.tpu.serve.policy``): ``round_robin`` cycles healthy
+  replicas, ``least_queued`` takes the one with the fewest
+  queued+running queries at the last probe.
+- **shed** — a 429 (SchedulerQueueFull) from the chosen replica is NOT
+  surfaced: the request re-dispatches to the least-loaded healthy
+  replica that has not itself answered 429 for this request. Only when
+  every healthy replica is saturated does the client see a 429, with
+  ``Retry-After = min`` across the replicas' hints (the soonest any
+  capacity frees up anywhere in the fleet).
+- **re-dispatch** — a connection failure (or an injected
+  ``serve.dispatch`` fault: a replica dying mid-query) marks the
+  replica unhealthy and retries a different one, bounded by
+  ``spark.tpu.serve.dispatchRetries``. The single-flight result cache
+  keys re-dispatched queries to the same structural key, so the query
+  still executes at most once even when two replicas see it.
+
+Reference analogue: the driver-side OutputCommitCoordinator +
+ExecutorFailuresAllowlist shape (task re-offer on a different executor
+after a lost one, bounded by spark.task.maxFailures).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from spark_tpu import conf as CF
+from spark_tpu import faults, metrics
+
+#: response headers a replica sets that the router relays verbatim
+RELAY_HEADERS = ("X-Query-Id", "X-Queue-Wait-Ms", "X-Cache",
+                 "Retry-After", "X-SparkTpu-Replica")
+
+#: connection-level failures that mean "this replica is gone" — the
+#: re-dispatch trigger (same set the connect Client classifies as
+#: retryable)
+_CONN_ERRORS = (ConnectionRefusedError, ConnectionResetError,
+                ConnectionAbortedError, BrokenPipeError, OSError)
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is down (distinct from all-saturated, which is a
+    429 the client can retry after Retry-After)."""
+
+
+class Replica:
+    """One backend ConnectServer as the router sees it: URL, last
+    probed load, and health."""
+
+    def __init__(self, rid: str, url: str):
+        self.id = str(rid)
+        self.url = url.rstrip("/")
+        self.healthy = True
+        self.queue_depth = 0
+        self.running = 0
+        self.last_probe = 0.0
+
+    @property
+    def load(self) -> int:
+        return int(self.queue_depth) + int(self.running)
+
+    def snapshot(self) -> dict:
+        return {"id": self.id, "url": self.url,
+                "healthy": self.healthy,
+                "queue_depth": self.queue_depth,
+                "running": self.running}
+
+
+def _as_replica(i: int, r) -> Replica:
+    """Accept a ConnectServer, a URL string, or an (id, url) pair."""
+    if isinstance(r, Replica):
+        return r
+    if isinstance(r, str):
+        return Replica(f"r{i}", r)
+    if isinstance(r, (tuple, list)) and len(r) == 2:
+        return Replica(r[0], r[1])
+    rid = getattr(r, "replica_id", None) or f"r{i}"
+    return Replica(rid, r.url)
+
+
+class Federation:
+    """The replica set + dispatch engine; owned by a FederationRouter
+    but usable headless (bench drives it directly)."""
+
+    def __init__(self, replicas: Sequence, conf=None,
+                 timeout: float = 120.0):
+        self._conf = conf if conf is not None else CF.RuntimeConf()
+        self.replicas: List[Replica] = [
+            _as_replica(i, r) for i, r in enumerate(replicas)]
+        if not self.replicas:
+            raise ValueError("federation needs at least one replica")
+        self.timeout = float(timeout)
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # -- health ---------------------------------------------------------------
+
+    def probe(self, force: bool = False) -> None:
+        """Refresh each replica's /health snapshot; throttled by
+        ``spark.tpu.serve.healthProbeSeconds`` unless forced. A probe
+        failure marks the replica unhealthy; a later success revives
+        it (a restarted replica rejoins without router restart)."""
+        try:
+            max_age = float(self._conf.get(CF.SERVE_HEALTH_PROBE_SECONDS))
+        except Exception:
+            max_age = float(CF.SERVE_HEALTH_PROBE_SECONDS.default)
+        now = time.time()
+        for r in self.replicas:
+            if not force and r.last_probe and \
+                    now - r.last_probe < max_age:
+                continue
+            try:
+                with urllib.request.urlopen(r.url + "/health",
+                                            timeout=2.0) as resp:
+                    h = json.loads(resp.read())
+                r.healthy = h.get("status") == "ok"
+                r.queue_depth = int(h.get("queue_depth", 0))
+                r.running = int(h.get("running", 0))
+                rid = h.get("replica")
+                if rid:
+                    r.id = str(rid)
+            except Exception:
+                r.healthy = False
+            r.last_probe = time.time()
+
+    def healthy(self) -> List[Replica]:
+        return [r for r in self.replicas if r.healthy]
+
+    def status(self) -> List[dict]:
+        return [r.snapshot() for r in self.replicas]
+
+    # -- selection ------------------------------------------------------------
+
+    def pick(self, affinity: Optional[str] = None,
+             exclude: Sequence[str] = (),
+             least_loaded: bool = False) -> Optional[Replica]:
+        """Next replica per policy among healthy, non-excluded ones.
+        ``affinity`` (a replica id) wins when that replica is still
+        eligible — consistent session routing keeps a client's
+        scheduler pool state and compile warmth on one backend.
+        ``least_loaded`` forces the load-based choice regardless of
+        policy: the shed path always moves work to the emptiest queue."""
+        pool = [r for r in self.healthy() if r.id not in set(exclude)]
+        if not pool:
+            return None
+        if affinity:
+            for r in pool:
+                if r.id == affinity:
+                    return r
+        try:
+            policy = str(self._conf.get(CF.SERVE_POLICY))
+        except Exception:
+            policy = str(CF.SERVE_POLICY.default)
+        if least_loaded or policy == "least_queued":
+            return min(pool, key=lambda r: (r.load, r.id))
+        with self._lock:
+            r = pool[self._rr % len(pool)]
+            self._rr += 1
+        return r
+
+    # -- dispatch -------------------------------------------------------------
+
+    def forward(self, replica: Replica, method: str, path: str,
+                body: Optional[bytes],
+                headers: Optional[dict] = None
+                ) -> Tuple[int, bytes, Dict[str, str]]:
+        """One HTTP round trip to a replica. Returns (code, body,
+        relay-headers); 4xx/5xx come back as values (HTTPError bodies
+        are real payloads here: 429 carries retry_after_s), connection
+        failures raise for the re-dispatch loop."""
+        req = urllib.request.Request(
+            replica.url + path, data=body, method=method,
+            headers=headers or {})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                data = resp.read()
+                hdr = {k: resp.headers[k] for k in RELAY_HEADERS
+                       if resp.headers.get(k)}
+                return resp.status, data, hdr
+        except urllib.error.HTTPError as e:
+            data = e.read()
+            hdr = {k: e.headers[k] for k in RELAY_HEADERS
+                   if e.headers.get(k)}
+            return e.code, data, hdr
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, _CONN_ERRORS):
+                raise reason
+            raise
+
+    def dispatch(self, method: str, path: str, body: Optional[bytes],
+                 headers: Optional[dict] = None,
+                 affinity: Optional[str] = None
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Route one request: pick -> forward, shedding 429s to the
+        least-loaded remaining replica and re-dispatching around dead
+        ones (bounded). The return is what the client sees."""
+        try:
+            retries = max(0, int(
+                self._conf.get(CF.SERVE_DISPATCH_RETRIES)))
+        except Exception:
+            retries = int(CF.SERVE_DISPATCH_RETRIES.default)
+        exhausted: set = set()    # saturated (429) this request
+        dead: set = set()         # connection-failed this request
+        retry_afters: List[float] = []
+        last_err: Optional[BaseException] = None
+        shed = False
+        for attempt in range(retries + len(self.replicas) + 1):
+            self.probe()
+            r = self.pick(affinity=affinity,
+                          exclude=exhausted | dead,
+                          least_loaded=shed)
+            affinity = None  # only honored for the first choice
+            if r is None:
+                break
+            metrics.note_serve("dispatches")
+            metrics.record("serve", phase="dispatch", replica=r.id,
+                           path=path)
+            try:
+                faults.inject("serve.dispatch", self._conf)
+                code, data, hdr = self.forward(
+                    r, method, path, body, headers)
+            except _CONN_ERRORS as e:
+                last_err = e
+                r.healthy = False
+                dead.add(r.id)
+                if len(dead) > retries:
+                    break
+                metrics.note_serve("replica_failures")
+                metrics.note_serve("redispatches")
+                metrics.record("serve", phase="replica_down",
+                               replica=r.id, error=type(e).__name__)
+                metrics.record("serve", phase="redispatch",
+                               replica=r.id)
+                continue
+            except faults.InjectedFault as e:
+                last_err = e
+                if e.kind not in ("transient", "hang"):
+                    raise  # corrupt/oom: surface typed, no retry
+                # injected replica death mid-query: same recovery as a
+                # real connection failure
+                r.healthy = False
+                dead.add(r.id)
+                if len(dead) > retries:
+                    break
+                metrics.note_serve("replica_failures")
+                metrics.note_serve("redispatches")
+                metrics.record("serve", phase="replica_down",
+                               replica=r.id, error=type(e).__name__)
+                metrics.record("serve", phase="redispatch",
+                               replica=r.id)
+                continue
+            if code == 429:
+                # admission shedding: this replica's scheduler is
+                # full — take the request to the emptiest other queue
+                exhausted.add(r.id)
+                try:
+                    detail = json.loads(data)
+                    ra = float(hdr.get("Retry-After")
+                               or detail.get("retry_after_s") or 0.0)
+                except Exception:
+                    ra = 0.0
+                retry_afters.append(ra)
+                shed = True
+                metrics.note_serve("sheds")
+                metrics.record("serve", phase="shed", replica=r.id,
+                               retry_after_s=ra)
+                continue
+            return code, data, hdr
+        if retry_afters:
+            # ALL healthy replicas saturated: now (and only now) the
+            # client sees the 429; Retry-After is the soonest any
+            # replica expects capacity
+            ra = min(retry_afters)
+            metrics.note_serve("rejected")
+            metrics.record("serve", phase="rejected",
+                           retry_after_s=ra)
+            body_out = json.dumps(
+                {"error": "SchedulerQueueFull",
+                 "message": "all replicas saturated",
+                 "retry_after_s": ra}).encode()
+            return 429, body_out, {"Retry-After": f"{ra:g}"}
+        if last_err is not None:
+            raise NoHealthyReplica(
+                f"dispatch failed after replica failures "
+                f"(last: {last_err!r})") from last_err
+        raise NoHealthyReplica("no healthy replica available")

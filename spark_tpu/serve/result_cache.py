@@ -1,0 +1,222 @@
+"""Plan-keyed Arrow result cache with single-flight execution.
+
+The serving-economics argument (Flare, arxiv 1703.08219): once
+per-query compute is native-fast, the dominant serving costs are
+dispatch and redundant re-execution of identical dashboard-style
+queries. This cache removes the second cost: results are keyed by the
+SAME structural plan key that measured-admission and the compile store
+use (plan/logical.structural_key), folded with the scan-source
+mtime/size fingerprint io/datasource.py already computes — so a
+rewritten source file misses naturally and the stale entry ages out
+LRU, exactly like the datasource's own batch cache.
+
+Values are the Arrow-IPC-serialized result stream, which is the byte
+string the connect server would have produced anyway: a hit returns
+the identical bytes an uncached execution serializes, so the on/off
+sweep is byte-identical by construction.
+
+Single-flight: a thundering herd of identical queries (8 clients
+refreshing the same dashboard) costs ONE device execution — the first
+arrival owns the execution, the rest block on its flight and read the
+serialized result. Reference shape: CacheManager._materialize's
+per-entry lock (api/session.py); the reference system's analogue is
+the BlockManager's ``doPutIterator`` single-writer semantics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_tpu import conf as CF
+from spark_tpu import metrics
+from spark_tpu.storage.lru import LruDict
+
+#: follower wait bound per round: the owner always sets the flight
+#: event in a ``finally``, so this only guards against an owner thread
+#: killed by interpreter shutdown; on expiry the follower loops and
+#: may become the owner itself.
+_FLIGHT_WAIT_S = 600.0
+
+
+def scan_fingerprints(plan) -> Tuple[Any, ...]:
+    """Freshness token over every scan source in ``plan``: the
+    (path, mtime_ns, size) fingerprint FileSource computes for its own
+    cache invalidation. Sources without one (in-memory Relations) key
+    by object identity, which structural_key already does."""
+    from spark_tpu.plan import logical as L
+
+    out = []
+    for scan in L.collect_nodes(plan, L.UnresolvedScan):
+        fp = None
+        fpf = getattr(scan.source, "_fingerprint", None)
+        if callable(fpf):
+            try:
+                fp = fpf()
+            except Exception:
+                fp = None
+        out.append(fp if fp is not None else ("src", id(scan.source)))
+    return tuple(out)
+
+
+def plan_result_key(plan) -> Tuple[Any, ...]:
+    """Cache key: injective structural plan identity + per-source
+    freshness. Process-local (structural_key embeds source object
+    identity) — each replica process keys its own cache, which is the
+    correct scope because fingerprints are local filesystem stats."""
+    return (plan.structural_key(), scan_fingerprints(plan))
+
+
+def key_digest(key: Tuple[Any, ...]) -> str:
+    """Short stable digest of a cache key for event-log correlation
+    (the full structural key is huge and unreadable in JSON)."""
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+
+def table_to_ipc(tbl: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    return sink.getvalue()
+
+
+def ipc_to_table(blob: bytes) -> pa.Table:
+    return pa.ipc.open_stream(io.BytesIO(blob)).read_all()
+
+
+class _Flight:
+    """One in-flight execution: followers wait on the event and read
+    either the serialized result or the owner's exception."""
+
+    __slots__ = ("event", "blob", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.blob: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+
+
+class ResultCache:
+    """Byte-bounded (``spark.tpu.serve.resultCache.maxBytes``, read
+    live) LRU of Arrow-IPC result streams with single-flight execution
+    per key. Shared across in-process replicas via the session
+    (connect/server.py attaches one per session), so the herd
+    guarantee holds even when the router spreads identical queries
+    over several replicas."""
+
+    def __init__(self, conf):
+        self._conf = conf
+        self._lru = LruDict(
+            "serve_results",
+            cap=4096,
+            max_bytes_entry=CF.SERVE_RESULT_CACHE_MAX_BYTES,
+            weigher=len,
+            conf=conf)
+        self._flights: dict = {}
+        self._lock = threading.Lock()
+
+    def enabled(self) -> bool:
+        try:
+            return bool(self._conf.get(CF.SERVE_RESULT_CACHE_ENABLED))
+        except Exception:
+            return False
+
+    def _max_bytes(self) -> int:
+        try:
+            return int(self._conf.get(CF.SERVE_RESULT_CACHE_MAX_BYTES))
+        except Exception:
+            return int(CF.SERVE_RESULT_CACHE_MAX_BYTES.default)
+
+    def _publish_gauges(self) -> None:
+        metrics.set_gauge("serve.result_cache.entries", len(self._lru))
+        metrics.set_gauge("serve.result_cache.bytes",
+                          self._lru.total_bytes)
+
+    def get_or_execute(self, key, execute: Callable[[], pa.Table]
+                       ) -> Tuple[bytes, str]:
+        """Return ``(arrow_ipc_bytes, status)`` for ``key``; status is
+        ``hit`` (served from cache), ``miss`` (this call owned the
+        device execution) or ``wait`` (piggybacked on a concurrent
+        execution of the same key). ``execute()`` runs AT MOST once
+        across all concurrent callers with the same key."""
+        kd = key_digest(key)
+        while True:
+            blob = self._lru.get(key)
+            if blob is not None:
+                metrics.note_serve("hits")
+                metrics.record("serve_cache", phase="hit", key=kd,
+                               bytes=len(blob))
+                return blob, "hit"
+            with self._lock:
+                fl = self._flights.get(key)
+                owner = fl is None
+                if owner:
+                    fl = self._flights[key] = _Flight()
+            if owner:
+                try:
+                    t0 = time.perf_counter()
+                    tbl = execute()
+                    blob = table_to_ipc(tbl)
+                    fl.blob = blob
+                    self.put(key, blob)
+                except BaseException as e:
+                    fl.error = e
+                    raise
+                finally:
+                    fl.event.set()
+                    with self._lock:
+                        self._flights.pop(key, None)
+                metrics.note_serve("misses")
+                metrics.record(
+                    "serve_cache", phase="execute", key=kd,
+                    bytes=len(blob),
+                    ms=round((time.perf_counter() - t0) * 1e3, 2))
+                metrics.record("serve_cache", phase="miss", key=kd,
+                               bytes=len(blob))
+                return blob, "miss"
+            # follower: block on the owner's flight
+            metrics.note_serve("waits")
+            fl.event.wait(timeout=_FLIGHT_WAIT_S)
+            if fl.error is not None:
+                # the owner's failure is this caller's failure too —
+                # a SchedulerQueueFull here propagates so the router
+                # can shed the whole herd to another replica
+                raise fl.error
+            if fl.blob is not None:
+                metrics.record("serve_cache", phase="wait", key=kd,
+                               bytes=len(fl.blob))
+                return fl.blob, "wait"
+            # owner vanished without result or error (interpreter
+            # teardown): loop and take ownership
+
+    def put(self, key, blob: bytes) -> None:
+        """Insert one serialized result; an oversized single result is
+        served but never cached (it would evict the whole cache for
+        one entry)."""
+        if len(blob) <= self._max_bytes():
+            self._lru[key] = blob
+        self._publish_gauges()
+
+    def lookup(self, key) -> Optional[bytes]:
+        return self._lru.get(key)
+
+    def clear(self) -> None:
+        self._lru.clear()
+        self._publish_gauges()
+
+    def stats(self) -> dict:
+        counters = metrics.serve_stats()
+        return {
+            "entries": len(self._lru),
+            "bytes": self._lru.total_bytes,
+            "max_bytes": self._max_bytes(),
+            "evictions": self._lru.evictions,
+            "hits": counters.get("hits", 0),
+            "misses": counters.get("misses", 0),
+            "waits": counters.get("waits", 0),
+        }

@@ -1,4 +1,4 @@
-"""Bounded LRU mapping for jitted-stage caches.
+"""Bounded LRU mapping for jitted-stage and serve-tier caches.
 
 The fused-stage caches (physical/planner._STAGE_CACHE and
 parallel/executor._DIST_STAGE_CACHE) were unbounded dicts — a
@@ -8,13 +8,19 @@ forever. This wrapper gives them LRU semantics with an entry cap read
 LIVE from ``spark.tpu.jit.stageCacheEntries`` (active session conf, so
 serving deployments tune it without restarts) and publishes the live
 size as a metrics gauge.
+
+The serve-tier result cache (serve/result_cache.py) reuses it with a
+BYTE bound instead: pass ``weigher`` (value -> size) and a
+``max_bytes`` cap (int, or a conf.ConfigEntry via ``max_bytes_entry``
+read live) and inserts evict oldest-accessed entries until the total
+weight fits.
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any
+from typing import Any, Callable, Optional
 
 from spark_tpu import metrics
 
@@ -24,26 +30,53 @@ class LruDict:
     working; inserts evict oldest-accessed entries beyond the cap.
     Thread-safe: scheduler workers share these caches."""
 
-    def __init__(self, name: str, cap_entry=None, cap: int = 512):
+    def __init__(self, name: str, cap_entry=None, cap: int = 512,
+                 max_bytes_entry=None, max_bytes: Optional[int] = None,
+                 weigher: Optional[Callable[[Any], int]] = None,
+                 conf=None):
         self._d: "OrderedDict[Any, Any]" = OrderedDict()
         self._name = name
+        #: explicit RuntimeConf for live entry reads; None falls back
+        #: to the active session's conf (the jit-cache call sites)
+        self._conf = conf
         self._cap_entry = cap_entry  # conf.ConfigEntry, read live
         self._cap = int(cap)
+        self._max_bytes_entry = max_bytes_entry  # ConfigEntry, read live
+        self._max_bytes = max_bytes
+        self._weigher = weigher
+        self._weights: "OrderedDict[Any, int]" = OrderedDict()
+        self._bytes = 0
         self._lock = threading.Lock()
         self.evictions = 0
 
+    def _conf_value(self, entry, fallback):
+        try:
+            if self._conf is not None:
+                return int(self._conf.get(entry))
+            from spark_tpu.api.session import SparkSession
+
+            sess = SparkSession.getActiveSession()
+            if sess is not None:
+                return int(sess.conf.get(entry))
+            return int(entry.default)
+        except Exception:
+            return fallback
+
     def _capacity(self) -> int:
         if self._cap_entry is not None:
-            try:
-                from spark_tpu.api.session import SparkSession
-
-                sess = SparkSession.getActiveSession()
-                if sess is not None:
-                    return max(1, int(sess.conf.get(self._cap_entry)))
-                return max(1, int(self._cap_entry.default))
-            except Exception:
-                pass
+            return max(1, self._conf_value(self._cap_entry, self._cap))
         return max(1, self._cap)
+
+    def _byte_capacity(self) -> Optional[int]:
+        """Live byte cap; None = no byte bound configured."""
+        if self._max_bytes_entry is not None:
+            default = self._max_bytes if self._max_bytes is not None \
+                else int(self._max_bytes_entry.default)
+            return max(0, self._conf_value(self._max_bytes_entry,
+                                           default))
+        if self._max_bytes is not None:
+            return max(0, int(self._max_bytes))
+        return None
 
     def get(self, key, default=None):
         with self._lock:
@@ -52,29 +85,47 @@ class LruDict:
             except KeyError:
                 return default
             self._d.move_to_end(key)
+            if key in self._weights:
+                self._weights.move_to_end(key)
             return v
 
     def __getitem__(self, key):
         with self._lock:
             v = self._d[key]
             self._d.move_to_end(key)
+            if key in self._weights:
+                self._weights.move_to_end(key)
             return v
 
     def __setitem__(self, key, value) -> None:
         cap = self._capacity()
+        byte_cap = self._byte_capacity()
+        w = int(self._weigher(value)) if self._weigher is not None else 0
         with self._lock:
+            if self._weigher is not None and key in self._weights:
+                self._bytes -= self._weights[key]
             self._d[key] = value
             self._d.move_to_end(key)
+            if self._weigher is not None:
+                self._weights[key] = w
+                self._weights.move_to_end(key)
+                self._bytes += w
             evicted = 0
-            while len(self._d) > cap:
-                self._d.popitem(last=False)
+            while len(self._d) > cap or (
+                    byte_cap is not None and self._bytes > byte_cap
+                    and self._d):
+                old_key, _ = self._d.popitem(last=False)
+                self._bytes -= self._weights.pop(old_key, 0)
                 evicted += 1
             size = len(self._d)
+            total = self._bytes
         if evicted:
             self.evictions += evicted
             metrics.record("jit_cache_evict", cache=self._name,
                            evicted=evicted, size=size, cap=cap)
         metrics.set_gauge(f"jit_cache.{self._name}.entries", size)
+        if self._weigher is not None:
+            metrics.set_gauge(f"jit_cache.{self._name}.bytes", total)
 
     def __contains__(self, key) -> bool:
         with self._lock:
@@ -84,11 +135,21 @@ class LruDict:
         with self._lock:
             return len(self._d)
 
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
+            self._weights.clear()
+            self._bytes = 0
         metrics.set_gauge(f"jit_cache.{self._name}.entries", 0)
+        if self._weigher is not None:
+            metrics.set_gauge(f"jit_cache.{self._name}.bytes", 0)
 
     def pop(self, key, default=None):
         with self._lock:
+            self._bytes -= self._weights.pop(key, 0)
             return self._d.pop(key, default)

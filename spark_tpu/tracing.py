@@ -454,6 +454,67 @@ def format_analysis_profile(
     return "\n".join(lines)
 
 
+def serve_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up federation-tier events (spark_tpu/serve/): per-replica
+    dispatch outcomes from ``serve`` events ({replica: {dispatched,
+    shed, redispatched, failed}}), result-cache activity from
+    ``serve_cache`` events ({hit, miss, wait, execute} counts plus
+    cached-execution ms saved), and the lifetime counters
+    (metrics.serve_stats)."""
+    evs = events if events is not None else metrics.recent(4096)
+    replicas: Dict[str, dict] = {}
+    cache = {"hit": 0, "miss": 0, "wait": 0, "execute": 0,
+             "execute_ms": 0.0}
+    for e in evs:
+        kind = e.get("kind")
+        if kind == "serve":
+            rid = str(e.get("replica", "?"))
+            rec = replicas.setdefault(rid, {
+                "dispatched": 0, "shed": 0, "redispatched": 0,
+                "failed": 0})
+            phase = e.get("phase")
+            key = {"dispatch": "dispatched", "shed": "shed",
+                   "redispatch": "redispatched",
+                   "replica_down": "failed"}.get(phase)
+            if key is not None:
+                rec[key] += 1
+        elif kind == "serve_cache":
+            phase = e.get("phase")
+            if phase in cache:
+                cache[phase] += 1
+            if phase == "execute":
+                cache["execute_ms"] = round(
+                    cache["execute_ms"] + float(e.get("ms", 0.0)), 3)
+    return {"replicas": replicas, "cache": cache,
+            "totals": metrics.serve_stats()}
+
+
+def format_serve_profile(profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else serve_profile()
+    t = p.get("totals", {})
+    if not p.get("replicas") and not any(p.get("cache", {}).values()) \
+            and not any(t.values()):
+        return "(no serve events recorded)"
+    c = p.get("cache", {})
+    lines = [
+        f"result cache: {c.get('hit', 0)} hits, {c.get('miss', 0)} "
+        f"misses, {c.get('wait', 0)} single-flight waits "
+        f"({c.get('execute', 0)} device executions, "
+        f"{c.get('execute_ms', 0.0):.1f}ms)",
+        f"router: {t.get('dispatches', 0)} dispatches, "
+        f"{t.get('sheds', 0)} sheds, {t.get('redispatches', 0)} "
+        f"re-dispatches, {t.get('rejected', 0)} rejected "
+        f"(all saturated), {t.get('replica_failures', 0)} replica "
+        "failures"]
+    if p.get("replicas"):
+        lines.append("replica       disp shed redisp fail")
+        for rid, rec in sorted(p["replicas"].items()):
+            lines.append(
+                f"{rid:<12} {rec['dispatched']:>5} {rec['shed']:>4} "
+                f"{rec['redispatched']:>6} {rec['failed']:>4}")
+    return "\n".join(lines)
+
+
 class PlanningTracker:
     """Phase timing for the planning pipeline (reference:
     catalyst/QueryPlanningTracker.scala). Use as

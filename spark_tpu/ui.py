@@ -20,6 +20,9 @@ Endpoints:
   /api/v1/lint          static plan analysis: recent AnalysisReports,
                         run/error/warning/gated counters, analysis.*
                         gauges
+  /api/v1/serve         federation tier: per-replica dispatch/shed/
+                        re-dispatch rollup, result-cache hit/miss/
+                        single-flight counters, serve.* gauges
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -187,6 +190,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "recent": [r.to_dict() for r in recent_reports()],
                 "gauges": {k: v for k, v in metrics.gauges().items()
                            if k.startswith("analysis.")},
+            })
+        elif url.path == "/api/v1/serve":
+            from spark_tpu import tracing
+
+            self._json({
+                "profile": tracing.serve_profile(events),
+                "counters": metrics.serve_stats(),
+                "gauges": {k: v for k, v in metrics.gauges().items()
+                           if k.startswith("serve.")},
             })
         elif url.path == "/api/v1/storage":
             session = getattr(self.server, "spark_session", None)

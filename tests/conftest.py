@@ -87,6 +87,10 @@ def pytest_configure(config):
         "markers",
         "analysis: static plan analysis — shape/dtype/capacity oracle, "
         "recompilation hazards, transform legality, invariant linter")
+    config.addinivalue_line(
+        "markers",
+        "serve: scale-out serving tier (spark_tpu/serve/) — federation "
+        "router, plan-keyed result cache, cross-replica shedding")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -94,8 +98,8 @@ def pytest_collection_modifyitems(config, items):
     # gets the SIGALRM deadlock guard so a wedged join fails instead of
     # hanging tier-1 (tests may still carry their own tighter timeout)
     for item in items:
-        if "compile" in item.keywords and \
-                item.get_closest_marker("timeout") is None:
+        if ("compile" in item.keywords or "serve" in item.keywords) \
+                and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):
         return
