@@ -133,6 +133,12 @@ BASELINE_MS = {1: 900.0, 3: 700.0, 5: 1100.0}
 # each measured in a FRESH subprocess so jit caches are honestly cold)
 WARMUP_MODE = os.environ.get("BENCH_WARMUP", "1") == "1"
 
+# BENCH_MVIEW=0 skips the materialized-view refresh A/B (K appended
+# micro-batches x M readers, spark.tpu.mview.incremental off vs on;
+# refresh latency + device executions + byte-identity land under
+# 'mview' in the result JSON)
+MVIEW_MODE = os.environ.get("BENCH_MVIEW", "1") == "1"
+
 
 def _warmup_child() -> None:
     """Subprocess entry for the cold-start A/B (BENCH_WARMUP_CHILD=1):
@@ -509,6 +515,115 @@ def _run_serve_ab(spark, concurrency: int, replicas_n: int,
     return out
 
 
+def _run_mview_ab(spark, appends: int = 8, readers: int = 3,
+                  base_rows: int = 200_000, delta_rows: int = 1_000,
+                  n_keys: int = 64) -> dict:
+    """Materialized-view refresh A/B (spark_tpu/mview/): a re-mergeable
+    aggregate (groupBy(k).sum(v)) cached over a parquet directory, then
+    K appended micro-batch files. Arm OFF pins mview.incremental=False
+    (every refresh is a full recompute over the whole growing source);
+    arm ON merges the delta partials into the HBM-resident batch. Per
+    append we time the FIRST read (the refresh) and ``readers-1`` extra
+    reads (fresh fingerprint hits), count device plan executions via
+    the single-device engine entry point, and keep the Arrow IPC bytes
+    of every step so the two arms are checked byte-identical — a fast
+    refresh that serves different bytes would be worse than no number."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import spark_tpu.api.functions as F
+    from spark_tpu import metrics
+    from spark_tpu.physical import planner as _planner
+    from spark_tpu.serve import result_cache as rc
+
+    def write_part(d: str, name: str, n: int, offset: int) -> None:
+        i = np.arange(offset, offset + n)
+        pq.write_table(pa.table({
+            "k": pa.array([f"k{j % n_keys}" for j in i]),
+            "v": pa.array((i % 97).astype(np.int64)),
+        }), os.path.join(d, name))
+
+    real_exec = _planner.execute_logical
+    execs = [0]
+
+    def counting_exec(plan, optimize=True):
+        execs[0] += 1
+        return real_exec(plan, optimize)
+
+    def arm(incremental: bool) -> dict:
+        d = tempfile.mkdtemp(prefix="bench_mview_")
+        spark.conf.set("spark.tpu.mview.enabled", True)
+        spark.conf.set("spark.tpu.mview.incremental", incremental)
+        spark.cache_manager.clear()
+        metrics.reset_mview()
+        try:
+            write_part(d, "base.parquet", base_rows, 0)
+            df = (spark.read.parquet(d).groupBy("k")
+                  .agg(F.sum("v").alias("s")))
+            df.cache()
+            t0 = time.perf_counter()
+            df.collect()  # cold materialize (off the A/B clock)
+            cold_ms = (time.perf_counter() - t0) * 1e3
+            refresh_ms, read_ms, step_bytes = [], [], []
+            _planner.execute_logical = counting_exec
+            execs[0] = 0
+            try:
+                for j in range(appends):
+                    write_part(d, f"delta{j:04d}.parquet", delta_rows,
+                               base_rows + j * delta_rows)
+                    t0 = time.perf_counter()
+                    tbl = df.toArrow()  # first reader pays the refresh
+                    refresh_ms.append((time.perf_counter() - t0) * 1e3)
+                    step_bytes.append(rc.table_to_ipc(tbl))
+                    for _ in range(max(0, readers - 1)):
+                        t0 = time.perf_counter()
+                        df.toArrow()  # fingerprint-fresh store hit
+                        read_ms.append(
+                            (time.perf_counter() - t0) * 1e3)
+            finally:
+                _planner.execute_logical = real_exec
+            stats = metrics.mview_stats()
+            return {
+                "incremental": incremental,
+                "cold_ms": round(cold_ms, 1),
+                "refresh_ms_p50": round(
+                    _percentile(refresh_ms, 50), 1),
+                "refresh_ms_p95": round(
+                    _percentile(refresh_ms, 95), 1),
+                "refresh_ms_total": round(sum(refresh_ms), 1),
+                "read_hit_ms_p50": round(_percentile(read_ms, 50), 1),
+                "device_executions": execs[0],
+                "incremental_merges": stats["incremental_merges"],
+                "full_recomputes": stats["full_recomputes"],
+                "_bytes": step_bytes,
+            }
+        finally:
+            spark.cache_manager.clear()
+            spark.conf.unset("spark.tpu.mview.incremental")
+            spark.conf.unset("spark.tpu.mview.enabled")
+            shutil.rmtree(d, ignore_errors=True)
+
+    out = {"appends": appends, "readers": readers,
+           "base_rows": base_rows, "delta_rows": delta_rows}
+    off = arm(False)
+    on = arm(True)
+    identical = (len(off["_bytes"]) == len(on["_bytes"])
+                 and all(a == b for a, b in
+                         zip(off["_bytes"], on["_bytes"])))
+    off.pop("_bytes")
+    on.pop("_bytes")
+    out["recompute_per_append"] = off
+    out["incremental"] = on
+    out["byte_identical"] = identical
+    if on["refresh_ms_total"]:
+        out["refresh_speedup"] = round(
+            off["refresh_ms_total"] / on["refresh_ms_total"], 2)
+    return out
+
+
 def main():
     import argparse
 
@@ -739,6 +854,27 @@ def main():
                    "serve": serve_ab,
                    "robustness": _robustness_counters()})
 
+    mview = None
+    if MVIEW_MODE:
+        if _wall_remaining() <= 5:
+            mview = {"error": "skipped: wall budget exhausted",
+                     "phase": "mview"}
+        else:
+            print("[bench] mview A/B: appended micro-batches, "
+                  "spark.tpu.mview.incremental off vs on",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_query_deadline()):
+                    mview = _run_mview_ab(spark)
+            except _QueryTimeout:
+                mview = {"error": "timeout"}
+            except Exception as e:
+                mview = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "mview": mview,
+                   "robustness": _robustness_counters()})
+
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
     # headline number stays meaningful (flagged via queries_failed)
@@ -773,6 +909,7 @@ def main():
         **({"adaptive": adaptive} if adaptive is not None else {}),
         **({"serving": serving} if serving is not None else {}),
         **({"serve": serve_ab} if serve_ab is not None else {}),
+        **({"mview": mview} if mview is not None else {}),
         **({"analysis": analysis_overhead}
            if analysis_overhead is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}

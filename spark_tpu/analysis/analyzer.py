@@ -87,6 +87,29 @@ def _legality_diags(plan: L.LogicalPlan,
     return diags
 
 
+def _mview_diags(plan: L.LogicalPlan) -> List[Diagnostic]:
+    """Materialized-view candidacy for root aggregates (the only plans
+    mview registration accepts): surfaces whether a cache() of this
+    exact plan would refresh incrementally, by full recompute, or not
+    register at all — the PLAN-MVIEW-* family mirrors
+    mview/view.inspect_plan so explain(mode="lint") and the manager
+    can never disagree."""
+    if not isinstance(plan, L.Aggregate):
+        return []
+    try:
+        from spark_tpu.mview import inspect_plan
+    except Exception:
+        return []
+    try:
+        insp = inspect_plan(plan)
+    except Exception:
+        return []
+    return [Diagnostic(code=code, level="info",
+                       node=plan.node_string(), message=message,
+                       hint=hint)
+            for code, message, hint in insp.diagnostics]
+
+
 def _aval_cross_check(optimized: L.LogicalPlan,
                       estimates) -> List[Diagnostic]:
     """The oracle's root aval must agree with the physical planner's
@@ -164,6 +187,7 @@ def analyze(plan: L.LogicalPlan, conf=None,
         hz, stable = hazards.detect(optimized, conf)
         diags.extend(hz)
         diags.extend(_legality_diags(optimized, intent))
+        diags.extend(_mview_diags(optimized))
 
         # estimate-divergence: the static oracle vs what admission
         # control will actually believe (AQE-measured bytes preferred)

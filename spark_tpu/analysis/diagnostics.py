@@ -40,6 +40,26 @@ Diagnostic codes (stable API — tests and deployments key on these):
                             tiers execute it directly
 - ``PLAN-ANALYZE-FAIL``     the analyzer itself failed on this plan
                             (reported, never raised)
+
+Materialized-view candidacy (root aggregates only; mirrors
+``mview/view.inspect_plan`` so the linter and the view manager can
+never disagree):
+
+- ``PLAN-MVIEW-OK``         cache() of this plan registers an
+                            INCREMENTALLY maintainable view: appended
+                            files merge into the cached batch without
+                            a full recompute
+- ``PLAN-MVIEW-RECOMPUTE``  registrable, but every refresh pays a
+                            full device recompute (aggregate not
+                            exactly re-mergeable)
+- ``PLAN-MVIEW-KEYS``       a grouping key is not carried through to
+                            the output as a plain column, so delta
+                            partials cannot be re-grouped
+- ``PLAN-MVIEW-SOURCE``     not registrable: zero/many scans, mixed
+                            stream+file sources, or a source without
+                            a file fingerprint
+- ``PLAN-MVIEW-SHAPE``      not registrable: the aggregate is not at
+                            the plan root
 """
 
 from __future__ import annotations

@@ -190,6 +190,10 @@ class CacheManager:
         # entry = [plan, entry lock]
         self._entries: Dict[str, list] = {}
         self._lock = threading.Lock()
+        # set by SparkSession: the materialized-view manager; when a
+        # cached key is a registered view, materialization delegates
+        # to its freshness-checking refresh path (spark_tpu/mview/)
+        self._mview = None
 
     @staticmethod
     def _key(plan: L.LogicalPlan):
@@ -206,6 +210,8 @@ class CacheManager:
         with self._lock:
             self._entries.setdefault(
                 self._key(plan), [plan, threading.Lock()])
+        if self._mview is not None:
+            self._mview.maybe_register(plan)
 
     def drop(self, plan: L.LogicalPlan) -> bool:
         key = self._key(plan)
@@ -213,6 +219,8 @@ class CacheManager:
             entry = self._entries.pop(key, None)
         if entry is None:
             return False
+        if self._mview is not None:
+            self._mview.unregister(key)
         self._store.remove(self._skey(key))  # releases the bytes
         return True
 
@@ -220,6 +228,8 @@ class CacheManager:
         with self._lock:
             keys = list(self._entries)
             self._entries.clear()
+        if self._mview is not None:
+            self._mview.clear_file_views()
         for key in keys:
             self._store.remove(self._skey(key))
 
@@ -244,7 +254,16 @@ class CacheManager:
     def _materialize(self, node: L.LogicalPlan, entry: list, run):
         """Store-hit or single-flight recompute; pin=True holds the
         batch for the duration of the enclosing query's pin_scope."""
-        skey = self._skey(self._key(node))
+        key = self._key(node)
+        skey = self._skey(key)
+        if self._mview is not None:
+            view = self._mview.view_for(key)
+            if view is not None:
+                # registered materialized view: the manager checks the
+                # source fingerprint and refreshes in place before
+                # serving (a plain store hit would serve stale bytes)
+                return self._mview.materialize(
+                    view, entry[1], run, self._store, skey)
         batch = self._store.get(skey, pin=True)
         if batch is not None:
             return batch
@@ -402,6 +421,13 @@ class SparkSession:
         self.memory_manager = UnifiedMemoryManager(conf=self.conf)
         self.memory_store = MemoryStore(self.memory_manager)
         self.cache_manager = CacheManager(store=self.memory_store)
+        # materialized views ride on the plan cache: cache() promotes
+        # qualifying aggregates to views; the cache's materialize path
+        # delegates to the view manager for freshness (spark_tpu/mview/)
+        from spark_tpu.mview import ViewManager
+
+        self.mview_manager = ViewManager(self)
+        self.cache_manager._mview = self.mview_manager
         self._stopped = False
         from spark_tpu.extensions import Extensions
 

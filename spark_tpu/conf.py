@@ -423,6 +423,39 @@ SERVE_REPLICAS = register(
     "pass one explicitly.", int)
 
 
+# ---- materialized views (spark_tpu/mview/) --------------------------------
+
+MVIEW_ENABLED = register(
+    "spark.tpu.mview.enabled", False,
+    "Treat df.cache() of an aggregate over a fingerprinted file source "
+    "as a materialized view (spark_tpu/mview/): the cached device "
+    "batch is refreshed when the source files change instead of being "
+    "served stale, incrementally when the aggregate is exactly "
+    "re-mergeable.", bool)
+
+MVIEW_INCREMENTAL = register(
+    "spark.tpu.mview.incremental", True,
+    "Refresh appended-to views by executing the aggregate over the new "
+    "files only and re-merging the partials into the cached batch "
+    "(legal only for integer Sum / non-float Min/Max — everything "
+    "else full-recomputes). Off = always full recompute; both paths "
+    "are byte-identical, this is the A/B switch the on/off sweep "
+    "tests flip.", bool)
+
+MVIEW_REFRESH_RETRIES = register(
+    "spark.tpu.mview.refreshRetries", 2,
+    "Bounded retries of one incremental view refresh after a "
+    "transient failure (including injected mview.refresh faults) "
+    "before falling back to a full recompute.", int)
+
+MVIEW_SERVE_REPOPULATE = register(
+    "spark.tpu.mview.serveRepopulate", True,
+    "After a view refresh, proactively re-insert the refreshed "
+    "Arrow result into the serve-tier result cache under the NEW "
+    "fingerprint key, so federated readers keep hitting cache across "
+    "updates instead of cold-missing.", bool)
+
+
 class RuntimeConf:
     """Session-scoped mutable view over the registry."""
 

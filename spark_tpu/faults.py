@@ -37,6 +37,13 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          different replica; the single-flight result
                          cache guarantees the query still executes at
                          most once per structural key
+- ``mview.refresh``      one incremental materialized-view refresh
+                         (mview/manager.py): transient faults retry up
+                         to spark.tpu.mview.refreshRetries, anything
+                         past that falls back to a full recompute
+                         (file views) or re-raises so the streaming
+                         WAL replay redelivers the delta (stream
+                         views) — bytes stay identical either way
 
 Spec grammar (the conf value):
 
@@ -89,6 +96,7 @@ POINTS = (
     "scheduler.admit",
     "compile.background",
     "serve.dispatch",
+    "mview.refresh",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

@@ -178,27 +178,13 @@ class FileSource:
         """Freshness token over the underlying files ((path, mtime_ns,
         size) tuples) so a re-read after a rewrite never serves stale
         cached batches, and the memoized pyarrow dataset (which pins its
-        discovered file list) is rebuilt (round-2 advisor finding)."""
-        import os
+        discovered file list) is rebuilt (round-2 advisor finding).
+        The walk itself is shared with the serve result cache and the
+        materialized-view delta detector (io/fingerprint.py) so all
+        three invalidate identically."""
+        from spark_tpu.io.fingerprint import stat_paths
 
-        out = []
-        for p in self.paths:
-            if os.path.isdir(p):
-                for root, _, files in os.walk(p):
-                    for f in sorted(files):
-                        fp = os.path.join(root, f)
-                        try:
-                            st = os.stat(fp)
-                            out.append((fp, st.st_mtime_ns, st.st_size))
-                        except OSError:
-                            pass
-            else:
-                try:
-                    st = os.stat(p)
-                    out.append((p, st.st_mtime_ns, st.st_size))
-                except OSError:
-                    pass
-        return tuple(out)
+        return stat_paths(self.paths)
 
     def _open(self) -> pads.Dataset:
         fp = self._fingerprint()

@@ -1,0 +1,104 @@
+"""Shared scan-source freshness fingerprints.
+
+One (path, mtime_ns, size) walk used by every layer that must decide
+"are these files still the bytes I computed from?": the datasource's
+own batch/auto-cache invalidation (io/datasource.FileSource), the
+serve-tier result cache key (serve/result_cache.plan_result_key), and
+the materialized-view delta detector (spark_tpu/mview/). Before this
+module each of those carried its own copy of the stat walk, so an
+invalidation bug could exist in exactly one of them; now the walk,
+the per-plan collection, and the append-vs-rewrite classification are
+defined once.
+
+Fingerprints are plain tuples of ``(path, mtime_ns, size)`` triples in
+a deterministic order (sorted directory walks, path order as given),
+so tuple equality IS freshness equality and the tuples embed directly
+into cache keys.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+#: one stat triple: (absolute path, st_mtime_ns, st_size)
+StatTriple = Tuple[str, int, int]
+
+
+def stat_paths(paths: Sequence[str]) -> Tuple[StatTriple, ...]:
+    """Stat every file under ``paths`` (directories walk recursively,
+    files sorted per directory so the order is deterministic across
+    runs); unreadable entries are skipped — a vanished file simply
+    changes the fingerprint, which is the invalidation we want."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                for f in sorted(files):
+                    fp = os.path.join(root, f)
+                    try:
+                        st = os.stat(fp)
+                        out.append((fp, st.st_mtime_ns, st.st_size))
+                    except OSError:
+                        pass
+        else:
+            try:
+                st = os.stat(p)
+                out.append((p, st.st_mtime_ns, st.st_size))
+            except OSError:
+                pass
+    return tuple(out)
+
+
+def source_fingerprint(source) -> Optional[Tuple[StatTriple, ...]]:
+    """Fingerprint of one datasource object, None when the source has
+    no file identity (in-memory relations, streaming sources)."""
+    fpf = getattr(source, "_fingerprint", None)
+    if not callable(fpf):
+        return None
+    try:
+        return fpf()
+    except Exception:
+        return None
+
+
+def plan_fingerprints(plan) -> Tuple[Any, ...]:
+    """Freshness token over every scan source in ``plan``, in plan
+    order. Sources without a file fingerprint key by object identity —
+    which the structural plan key already embeds, so pairing this tuple
+    with ``structural_key()`` stays injective."""
+    from spark_tpu.plan import logical as L
+
+    out = []
+    for scan in L.collect_nodes(plan, L.UnresolvedScan):
+        fp = source_fingerprint(scan.source)
+        out.append(fp if fp is not None else ("src", id(scan.source)))
+    return tuple(out)
+
+
+def classify_delta(old: Tuple[StatTriple, ...],
+                   new: Tuple[StatTriple, ...]):
+    """Classify how a source moved from fingerprint ``old`` to ``new``:
+
+    - ``("unchanged", ())``      identical fingerprints
+    - ``("appended", added)``     every old file survives byte-identical
+                                  and only new files appeared — the
+                                  incremental-merge case; ``added`` is
+                                  the new paths in fingerprint order
+    - ``("changed", ())``        anything else (rewrite, truncation,
+                                  deletion, mtime bump) — only a full
+                                  recompute is sound
+    """
+    if old == new:
+        return "unchanged", ()
+    old_map = {p: (m, s) for p, m, s in old}
+    new_map = {p: (m, s) for p, m, s in new}
+    for p, stat in old_map.items():
+        if new_map.get(p) != stat:
+            return "changed", ()
+    added = tuple(p for p, _, _ in new if p not in old_map)
+    if not added:
+        # same paths, different order (should not happen with the
+        # deterministic walk, but never merge on a guess)
+        return "changed", ()
+    return "appended", added

@@ -264,6 +264,37 @@ def reset_serve() -> None:
             _SERVE[k] = 0
 
 
+# ---- materialized-view counters ---------------------------------------------
+
+#: the incremental materialized-view engine (spark_tpu/mview/) —
+#: view registrations, fresh-hit serves, incremental delta merges,
+#: full recomputes (non-mergeable plans, rewrites, incremental=off),
+#: transient refresh retries, retry-exhaustion fallbacks to full
+#: recompute, stream micro-batch merges, WAL-replay dedups dropped by
+#: the batch-id watermark, and serve-tier result-cache repopulations.
+#: Shown in tracing.mview_profile and /api/v1/mview.
+_MVIEW = {"registrations": 0, "hits": 0, "incremental_merges": 0,
+          "full_recomputes": 0, "refresh_retries": 0,
+          "refresh_fallbacks": 0, "stream_merges": 0,
+          "stream_dedups": 0, "serve_repopulations": 0}
+
+
+def note_mview(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _MVIEW[kind] = _MVIEW.get(kind, 0) + int(n)
+
+
+def mview_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_MVIEW)
+
+
+def reset_mview() -> None:
+    with _LOCK:
+        for k in list(_MVIEW):
+            _MVIEW[k] = 0
+
+
 class PipelineStats:
     """Wall-time accounting for the out-of-HBM chunk pipeline
     (physical/pipeline.py): per-stage totals (decode / filter /

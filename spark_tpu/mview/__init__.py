@@ -1,0 +1,27 @@
+"""Incrementally-maintained materialized views.
+
+``df.cache()`` on an aggregate over a fingerprinted file source — with
+``spark.tpu.mview.enabled`` — becomes a materialized VIEW: the cached
+HBM batch is refreshed when the source files change (today's plain
+cache serves stale bytes forever), and when only new files were
+APPENDED and the aggregate is exactly re-mergeable (integer Sum,
+non-float Min/Max — analysis/legality.remerge_verdict, the same rule
+the AQE skew fan trusts), the refresh executes the aggregate over the
+delta files only and re-merges the partials into the cached batch.
+Everything else falls back to a transparent full recompute; both paths
+are byte-identical under the on/off conf sweep.
+
+Streaming converges here too: each micro-batch commit publishes a
+delta event (streaming/execution.py) that stream-registered views
+merge, deduplicated by the WAL's batch id so replay after a crash
+never double-merges.
+
+See mview/view.py for the maintainability verdict (surfaced as
+PLAN-MVIEW-* diagnostics via ``df.explain(mode="lint")``) and
+mview/manager.py for the refresh/merge engine.
+"""
+
+from spark_tpu.mview.manager import ViewManager
+from spark_tpu.mview.view import MaterializedView, inspect_plan
+
+__all__ = ["ViewManager", "MaterializedView", "inspect_plan"]

@@ -1,0 +1,210 @@
+"""Materialized-view descriptors and the maintainability verdict.
+
+``inspect_plan`` answers, statically, the two questions the manager
+and the plan analyzer both need:
+
+1. is this plan REGISTRABLE as a view at all (root Aggregate over
+   exactly one fingerprinted file scan, or one streaming source), and
+2. is a registered view INCREMENTALLY maintainable (grouping keys
+   carried through to the output, every aggregate exactly
+   re-mergeable per analysis/legality.remerge_verdict)?
+
+A registrable-but-not-incremental view still refreshes — by full
+recompute — so freshness never depends on merge legality; legality
+only decides the device cost of a refresh. The same inspection feeds
+the ``PLAN-MVIEW-*`` diagnostic family in ``df.explain(mode="lint")``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from spark_tpu.analysis import legality
+from spark_tpu.expr import expressions as E
+from spark_tpu.plan import logical as L
+
+
+@dataclass(frozen=True)
+class MergeSpec:
+    """How to re-merge a view's own output with delta partials: group
+    by the key OUTPUT columns, re-apply each Sum/Min/Max to its own
+    output column (exact by the re-merge legality rule)."""
+
+    key_names: Tuple[str, ...]
+    merge_outs: Tuple[E.Expression, ...]
+
+    def merge_plan(self, child: L.LogicalPlan) -> L.LogicalPlan:
+        keys = tuple(E.Col(n) for n in self.key_names)
+        return L.Aggregate(keys, self.merge_outs, child)
+
+
+@dataclass(frozen=True)
+class Inspection:
+    """Static view-candidacy report for one logical plan."""
+
+    registrable: bool
+    incremental: bool
+    kind: str                     # "file" | "stream" | ""
+    scan: Optional[L.LogicalPlan]  # the single UnresolvedScan, if file
+    merge_spec: Optional[MergeSpec]
+    #: (code, message, hint) triples for the PLAN-MVIEW-* family
+    diagnostics: Tuple[Tuple[str, str, str], ...]
+
+
+def _not_registrable(code: str, message: str, hint: str) -> Inspection:
+    return Inspection(False, False, "", None, None,
+                      ((code, message, hint),))
+
+
+def _merge_spec(agg: L.Aggregate):
+    """Build the key/merge output lists, or a (code, message) pair when
+    the structure cannot be re-merged: every grouping must surface as a
+    plain output column (its value is what the merge re-groups by)."""
+    out_by_key = {}
+    for e in agg.aggregates:
+        inner = E.strip_alias(e)
+        if isinstance(inner, E.Col):
+            out_by_key[E.expr_key(inner)] = e.name
+    key_names = []
+    for g in agg.groupings:
+        name = out_by_key.get(E.expr_key(E.strip_alias(g)))
+        if name is None:
+            return None, (
+                "PLAN-MVIEW-KEYS",
+                f"grouping key {g} is not carried through to the "
+                "output as a plain column; the merge cannot re-group "
+                "delta partials without its value")
+        key_names.append(name)
+    merge_outs: List[E.Expression] = []
+    for e in agg.aggregates:
+        inner = E.strip_alias(e)
+        if isinstance(inner, E.Col):
+            merge_outs.append(E.Alias(E.Col(e.name), e.name))
+            continue
+        calls = E.collect_aggregates(inner)
+        # remerge_verdict (checked by the caller) guarantees exactly
+        # one Sum/Min/Max call equal to the whole expression
+        call = calls[0]
+        merge_outs.append(E.Alias(type(call)(E.Col(e.name)), e.name))
+    return MergeSpec(tuple(key_names), tuple(merge_outs)), None
+
+
+def inspect_plan(plan: L.LogicalPlan) -> Inspection:
+    """Classify ``plan`` as a materialized-view candidate. Only root
+    Aggregates are candidates (operators above the aggregate would have
+    to re-run over the refreshed state — out of scope, exactly the
+    streaming restriction)."""
+    from spark_tpu.io.fingerprint import source_fingerprint
+
+    if not isinstance(plan, L.Aggregate):
+        return _not_registrable(
+            "PLAN-MVIEW-SHAPE",
+            "materialized views require the aggregate at the plan "
+            "root",
+            "cache() the groupBy().agg() result itself; operators "
+            "above it re-run per query anyway")
+
+    from spark_tpu.streaming.execution import StreamingSource
+
+    streams = L.collect_nodes(plan, StreamingSource)
+    scans = L.collect_nodes(plan, L.UnresolvedScan)
+    if streams:
+        if len(streams) != 1 or scans:
+            return _not_registrable(
+                "PLAN-MVIEW-SOURCE",
+                "stream views require exactly one streaming source "
+                "and no file scans",
+                "split multi-source plans before registering")
+        kind, scan = "stream", None
+    else:
+        if len(scans) != 1:
+            return _not_registrable(
+                "PLAN-MVIEW-SOURCE",
+                f"materialized views require exactly one file scan "
+                f"(found {len(scans)})",
+                "joins of several sources refresh ambiguously; cache "
+                "each side instead")
+        scan = scans[0]
+        if source_fingerprint(scan.source) is None:
+            return _not_registrable(
+                "PLAN-MVIEW-SOURCE",
+                "the scan source has no file fingerprint (in-memory "
+                "relation?) — no delta to detect",
+                "only file-backed sources can be refreshed")
+        kind = "file"
+
+    diags: List[Tuple[str, str, str]] = []
+    v = legality.remerge_verdict(plan)
+    spec = None
+    if not v.ok:
+        diags.append((
+            "PLAN-MVIEW-RECOMPUTE",
+            f"view refreshes by FULL recompute: {v.reason} "
+            f"({v.offending})",
+            "integer Sum / non-float Min/Max aggregates merge "
+            "incrementally; others stay correct but pay a full "
+            "device recompute per refresh"))
+    else:
+        spec, err = _merge_spec(plan)
+        if spec is None:
+            code, message = err
+            diags.append((
+                code, message,
+                "add the grouping column itself to the aggregate "
+                "output list"))
+        else:
+            diags.append((
+                "PLAN-MVIEW-OK",
+                "view is incrementally maintainable: appended files "
+                "merge into the cached batch without a full recompute",
+                ""))
+    incremental = spec is not None
+    if kind == "stream" and not incremental:
+        # streams cannot be re-scanned, so a stream view without a
+        # merge path cannot exist at all
+        return Inspection(False, False, kind, scan, None, tuple(diags))
+    return Inspection(True, incremental, kind, scan, spec, tuple(diags))
+
+
+@dataclass(eq=False)
+class MaterializedView:
+    """One registered view: the plan, its inspection, and the mutable
+    refresh state (guarded by ``lock`` — the manager single-flights
+    refreshes per view)."""
+
+    key: Any                       # structural plan key
+    plan: L.LogicalPlan
+    inspection: Inspection
+    name: str = ""                 # stream views: reader handle
+    stream: str = ""               # stream views: source query name
+    fingerprint: Optional[tuple] = None   # file views: last refreshed
+    last_batch_id: int = -1        # stream views: WAL dedup watermark
+    state: Any = None              # stream views: merged device batch
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    refreshes: int = 0
+    incremental_merges: int = 0
+    full_recomputes: int = 0
+
+    @property
+    def kind(self) -> str:
+        return self.inspection.kind
+
+    def source(self):
+        return self.inspection.scan.source if self.inspection.scan \
+            is not None else None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "name": self.name,
+            "stream": self.stream,
+            "incremental": self.inspection.incremental,
+            "plan": self.plan.node_string(),
+            "files": len(self.fingerprint or ()),
+            "last_batch_id": self.last_batch_id,
+            "refreshes": self.refreshes,
+            "incremental_merges": self.incremental_merges,
+            "full_recomputes": self.full_recomputes,
+        }

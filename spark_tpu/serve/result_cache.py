@@ -47,21 +47,14 @@ _FLIGHT_WAIT_S = 600.0
 def scan_fingerprints(plan) -> Tuple[Any, ...]:
     """Freshness token over every scan source in ``plan``: the
     (path, mtime_ns, size) fingerprint FileSource computes for its own
-    cache invalidation. Sources without one (in-memory Relations) key
-    by object identity, which structural_key already does."""
-    from spark_tpu.plan import logical as L
+    cache invalidation — the SAME walk (io/fingerprint.py), so this
+    cache, the datasource auto-cache, and the materialized-view delta
+    detector can never disagree about staleness. Sources without one
+    (in-memory Relations) key by object identity, which structural_key
+    already does."""
+    from spark_tpu.io.fingerprint import plan_fingerprints
 
-    out = []
-    for scan in L.collect_nodes(plan, L.UnresolvedScan):
-        fp = None
-        fpf = getattr(scan.source, "_fingerprint", None)
-        if callable(fpf):
-            try:
-                fp = fpf()
-            except Exception:
-                fp = None
-        out.append(fp if fp is not None else ("src", id(scan.source)))
-    return tuple(out)
+    return plan_fingerprints(plan)
 
 
 def plan_result_key(plan) -> Tuple[Any, ...]:

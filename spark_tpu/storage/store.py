@@ -172,6 +172,53 @@ class MemoryStore:
                            bytes=nbytes, storage_bytes=self._bytes)
             return True
 
+    def update(self, key, batch, pin: bool = False) -> bool:
+        """Replace an entry's batch IN PLACE, re-accounting the byte
+        delta under the unified budget — the materialized-view refresh
+        path (a refreshed view keeps its key, pins, and LRU identity;
+        only the bytes change). Growth must fit like any other storage
+        reservation; when it cannot, the STALE entry is dropped rather
+        than kept (serving stale bytes is worse than recomputing) and
+        False is returned — the caller keeps using its batch, exactly
+        the ``put`` rejection contract. Absent keys fall through to
+        ``put``."""
+        nbytes = batch_nbytes(batch)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                delta = nbytes - e.nbytes
+                if delta > 0:
+                    # hold the entry against the eviction pass the
+                    # reservation may trigger (evicting the entry being
+                    # updated would corrupt the accounting below)
+                    e.pins += 1
+                    try:
+                        ok = self._m.reserve_storage(delta)
+                    finally:
+                        e.pins -= 1
+                    if not ok:
+                        self._entries.pop(key)
+                        self._bytes -= e.nbytes
+                        self.rejected_puts += 1
+                        metrics.record(
+                            "storage", phase="update_rejected",
+                            key=_short(key), bytes=nbytes,
+                            storage_bytes=self._bytes)
+                        return False
+                e.batch = batch
+                e.nbytes = nbytes
+                self._bytes += delta
+                self.put_bytes += max(0, delta)
+                e.last_access_t = time.time()
+                self._entries.move_to_end(key)
+                if pin:
+                    self._pin_locked(key, e)
+                metrics.record("storage", phase="update",
+                               key=_short(key), bytes=nbytes,
+                               delta=delta, storage_bytes=self._bytes)
+                return True
+        return self.put(key, batch, pin=pin)
+
     def remove(self, key) -> int:
         """Drop an entry regardless of LRU position (uncache); returns
         the bytes released. Pinned entries drop from the table too —

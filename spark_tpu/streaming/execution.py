@@ -161,6 +161,18 @@ class StreamingQuery:
 
     processAllAvailable = process_all_available
 
+    def _publish_delta(self, batch_id: int, new_rows) -> None:
+        """Hand the micro-batch's (late-filtered) rows to the
+        materialized-view manager BEFORE the WAL commit: a crash
+        between publish and commit replays the same batch id, which
+        the manager's batch-id watermark drops — subscribed views
+        never double-merge and never miss a committed batch. A view
+        merge that fails past its retries propagates from here, so
+        the batch stays uncommitted and replay redelivers it."""
+        mgr = getattr(self._session, "mview_manager", None)
+        if mgr is not None:
+            mgr.on_micro_batch(self.name, batch_id, new_rows)
+
     def _run_batch(self, batch_id: int, start: int, end: int) -> None:
         from spark_tpu.columnar.arrow import from_arrow
 
@@ -182,6 +194,7 @@ class StreamingQuery:
 
         if self._agg is None:
             out = self._to_arrow(_splice(self._plan, rel))
+            self._publish_delta(batch_id, new_rows)
             faults.inject("streaming.commit", self._session.conf)
             self._store.commit(batch_id, pa.table({}))
             self._log.commit(batch_id)
@@ -239,6 +252,7 @@ class StreamingQuery:
         if self.output_mode == "append":
             state_tbl, emitted = self._evict_closed(state_tbl)
 
+        self._publish_delta(batch_id, new_rows)
         faults.inject("streaming.commit", self._session.conf)
         self._store.commit(batch_id, state_tbl)
         self._log.commit(batch_id, watermark=self._max_event_time)

@@ -515,6 +515,71 @@ def format_serve_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
+def mview_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
+    """Roll up materialized-view events (spark_tpu/mview/): refresh
+    outcomes by how (incremental / full / fallback), retry + dedup
+    activity, per-view stream-merge counts, and the lifetime counters
+    (metrics.mview_stats)."""
+    evs = events if events is not None else metrics.recent(4096)
+    refresh = {"incremental": 0, "full": 0, "fallback": 0,
+               "materialize": 0, "files_merged": 0}
+    faults = {"retries": 0, "fallbacks": 0}
+    streams: Dict[str, dict] = {}
+    for e in evs:
+        if e.get("kind") != "mview":
+            continue
+        phase = e.get("phase")
+        if phase == "refresh":
+            how = str(e.get("how", "full"))
+            if how in refresh:
+                refresh[how] += 1
+            if how == "incremental":
+                refresh["files_merged"] += int(e.get("files", 0))
+        elif phase == "materialize":
+            refresh["materialize"] += 1
+        elif phase == "retry":
+            faults["retries"] += 1
+        elif phase == "fallback":
+            faults["fallbacks"] += 1
+        elif phase in ("stream_merge", "dedup"):
+            name = str(e.get("view", "?"))
+            rec = streams.setdefault(name, {"merges": 0, "dedups": 0,
+                                            "rows": 0})
+            if phase == "stream_merge":
+                rec["merges"] += 1
+                rec["rows"] += int(e.get("rows", 0))
+            else:
+                rec["dedups"] += 1
+    return {"refresh": refresh, "faults": faults, "streams": streams,
+            "totals": metrics.mview_stats()}
+
+
+def format_mview_profile(profile: Optional[Dict[str, dict]] = None
+                         ) -> str:
+    p = profile if profile is not None else mview_profile()
+    t = p.get("totals", {})
+    r = p.get("refresh", {})
+    if not any(r.values()) and not any(t.values()):
+        return "(no materialized-view events recorded)"
+    lines = [
+        f"views: {t.get('registrations', 0)} registered, "
+        f"{t.get('hits', 0)} fresh hits",
+        f"refresh: {r.get('incremental', 0)} incremental "
+        f"({r.get('files_merged', 0)} files merged), "
+        f"{r.get('full', 0)} full recomputes, "
+        f"{r.get('fallback', 0)} retry-exhaustion fallbacks, "
+        f"{t.get('refresh_retries', 0)} transient retries",
+        f"streaming: {t.get('stream_merges', 0)} micro-batch merges, "
+        f"{t.get('stream_dedups', 0)} replay dedups; "
+        f"{t.get('serve_repopulations', 0)} serve-cache repopulations"]
+    if p.get("streams"):
+        lines.append("stream view     merges dedups   rows")
+        for name, rec in sorted(p["streams"].items()):
+            lines.append(f"{name:<14} {rec['merges']:>6} "
+                         f"{rec['dedups']:>6} {rec['rows']:>6}")
+    return "\n".join(lines)
+
+
 class PlanningTracker:
     """Phase timing for the planning pipeline (reference:
     catalyst/QueryPlanningTracker.scala). Use as

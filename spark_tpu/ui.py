@@ -23,6 +23,9 @@ Endpoints:
   /api/v1/serve         federation tier: per-replica dispatch/shed/
                         re-dispatch rollup, result-cache hit/miss/
                         single-flight counters, serve.* gauges
+  /api/v1/mview         materialized views: refresh rollup
+                        (incremental/full/fallback), per-view state,
+                        stream merge/dedup counters, mview.* gauges
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -199,6 +202,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "counters": metrics.serve_stats(),
                 "gauges": {k: v for k, v in metrics.gauges().items()
                            if k.startswith("serve.")},
+            })
+        elif url.path == "/api/v1/mview":
+            from spark_tpu import tracing
+
+            session = getattr(self.server, "spark_session", None)
+            mgr = getattr(session, "mview_manager", None)
+            self._json({
+                "profile": tracing.mview_profile(events),
+                "counters": metrics.mview_stats(),
+                "views": mgr.views() if mgr is not None else [],
+                "gauges": {k: v for k, v in metrics.gauges().items()
+                           if k.startswith("mview.")},
             })
         elif url.path == "/api/v1/storage":
             session = getattr(self.server, "spark_session", None)

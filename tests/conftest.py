@@ -91,6 +91,11 @@ def pytest_configure(config):
         "markers",
         "serve: scale-out serving tier (spark_tpu/serve/) — federation "
         "router, plan-keyed result cache, cross-replica shedding")
+    config.addinivalue_line(
+        "markers",
+        "mview: incrementally-maintained materialized views "
+        "(spark_tpu/mview/) — delta detection, re-merge, stream "
+        "convergence, serve repopulation")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -98,7 +103,8 @@ def pytest_collection_modifyitems(config, items):
     # gets the SIGALRM deadlock guard so a wedged join fails instead of
     # hanging tier-1 (tests may still carry their own tighter timeout)
     for item in items:
-        if ("compile" in item.keywords or "serve" in item.keywords) \
+        if ("compile" in item.keywords or "serve" in item.keywords
+                or "mview" in item.keywords) \
                 and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):

@@ -279,6 +279,50 @@ def test_background_failure_pins_chunked(fact_parquet, kind):
     assert again == oracle
 
 
+@pytest.mark.timeout(300)
+def test_symbols_not_found_reload_recompiles_silently(
+        fact_parquet, tmp_path, monkeypatch):
+    """Regression for the XLA:CPU large-program limit (ROADMAP item 1):
+    a stored executable whose re-load dies with "Symbols not found"
+    must behave exactly like a corrupt entry — evicted from disk and
+    recompiled fresh — with the query never seeing the error, and the
+    recompiled entry must round-trip once re-loads work again."""
+    store_dir = str(tmp_path / "store")
+    _forget_process_state()
+    metrics.reset_exec_store()
+    with _session(**{"spark.tpu.compile.store.dir": store_dir}) as s1:
+        rows1 = _run_twice(s1, fact_parquet)
+        assert s1.compile_service.store.stats()["entries"] >= 1
+
+    # fresh "process" whose XLA refuses to re-load the serialization
+    _forget_process_state()
+    metrics.reset_exec_store()
+    from jax.experimental import serialize_executable as _se
+
+    def boom(*a, **k):
+        raise RuntimeError(
+            "Symbols not found: [__xla_cpu_runtime_AllReduce]")
+
+    monkeypatch.setattr(_se, "deserialize_and_load", boom)
+    with _session(**{"spark.tpu.compile.store.dir": store_dir}) as s2:
+        rows2 = _run_twice(s2, fact_parquet)  # must not raise
+        st = metrics.exec_store_stats()
+        assert st["corrupt"] >= 1, "failed re-load must read as corrupt"
+        assert st["hits"] == 0
+        assert st["puts"] >= 1, "recompile must re-populate the store"
+    assert rows2 == rows1
+
+    # with real deserialization back, the re-populated entries serve
+    monkeypatch.undo()
+    _forget_process_state()
+    metrics.reset_exec_store()
+    with _session(**{"spark.tpu.compile.store.dir": store_dir}) as s3:
+        rows3 = _run_twice(s3, fact_parquet)
+        st = metrics.exec_store_stats()
+        assert st["hits"] >= 1 and st["corrupt"] == 0
+    assert rows3 == rows1
+
+
 @pytest.mark.timeout(120)
 def test_corrupt_entry_is_miss_and_evicted(tmp_path):
     """A poisoned serialized executable must read as a miss AND be

@@ -1,0 +1,627 @@
+"""Incrementally-maintained materialized views (spark_tpu/mview/):
+delta classification, registration via cache(), incremental re-merge
+vs full recompute with byte-identity under the on/off conf sweep,
+the mview.refresh fault matrix, streaming convergence with WAL-replay
+dedup, store update accounting, and serve-tier repopulation."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_tpu import conf as CF
+from spark_tpu import faults, metrics
+from spark_tpu.api import functions as F
+from spark_tpu.columnar.arrow import to_arrow
+from spark_tpu.io.fingerprint import classify_delta, stat_paths
+from spark_tpu.serve.result_cache import table_to_ipc
+
+pytestmark = pytest.mark.mview
+
+
+# ---- helpers ----------------------------------------------------------------
+
+
+def _write(d, name, ks, vs, key_type=pa.string()):
+    pq.write_table(pa.table({"k": pa.array(ks, key_type),
+                             "v": pa.array(vs, pa.int64())}),
+                   os.path.join(d, name))
+
+
+def _base(d, key_type=pa.string()):
+    if key_type == pa.string():
+        ks = [f"k{i % 13}" for i in range(400)]
+    else:
+        ks = [i % 13 for i in range(400)]
+    _write(d, "base.parquet", ks, [i % 97 for i in range(400)],
+           key_type)
+
+
+@pytest.fixture
+def mview_on(spark):
+    """Arm the subsystem on the shared session, restoring afterwards
+    (registration happens at cache() time, so the flag must be set
+    before the test touches cache())."""
+    spark.conf.set("spark.tpu.mview.enabled", "true")
+    yield spark.conf
+    for key in ("spark.tpu.mview.enabled", "spark.tpu.mview.incremental",
+                "spark.tpu.mview.refreshRetries",
+                "spark.tpu.faultInjection.mview.refresh"):
+        try:
+            spark.conf.unset(key)
+        except KeyError:
+            pass
+    faults.reset(spark.conf)
+    spark.cache_manager.clear()
+
+
+def _sum_df(spark, d):
+    return spark.read.parquet(d).groupBy("k").agg(F.sum("v").alias("s"))
+
+
+def _rows(df):
+    return sorted(tuple(r.values()) for r in
+                  (r.asDict() for r in df.collect()))
+
+
+# ---- delta classification (io/fingerprint) ----------------------------------
+
+
+def test_classify_delta(tmp_path):
+    d = str(tmp_path)
+    _write(d, "a.parquet", ["x"], [1])
+    fp1 = stat_paths([d])
+    assert classify_delta(fp1, fp1) == ("unchanged", ())
+
+    _write(d, "b.parquet", ["y"], [2])
+    fp2 = stat_paths([d])
+    kind, added = classify_delta(fp1, fp2)
+    assert kind == "appended"
+    assert [os.path.basename(p) for p in added] == ["b.parquet"]
+
+    # rewrite of an existing file: mtime/size move -> changed
+    _write(d, "a.parquet", ["x", "x"], [1, 1])
+    kind, added = classify_delta(fp2, stat_paths([d]))
+    assert (kind, added) == ("changed", ())
+
+    # deletion -> changed
+    os.remove(os.path.join(d, "b.parquet"))
+    kind, added = classify_delta(fp2, stat_paths([d]))
+    assert (kind, added) == ("changed", ())
+
+
+# ---- registration + inspection ----------------------------------------------
+
+
+def test_inspect_plan_verdicts(spark, tmp_path):
+    import dataclasses
+
+    from spark_tpu.mview import inspect_plan
+
+    d = str(tmp_path)
+    _base(d)
+    scan_df = spark.read.parquet(d)
+
+    ok = inspect_plan(scan_df.groupBy("k").agg(
+        F.sum("v").alias("s"))._plan)
+    assert ok.registrable and ok.incremental and ok.kind == "file"
+    assert ok.diagnostics[0][0] == "PLAN-MVIEW-OK"
+    assert ok.merge_spec.key_names == ("k",)
+
+    avg = inspect_plan(scan_df.groupBy("k").agg(
+        F.avg("v").alias("a"))._plan)
+    assert avg.registrable and not avg.incremental
+    assert avg.diagnostics[0][0] == "PLAN-MVIEW-RECOMPUTE"
+
+    shape = inspect_plan(scan_df.groupBy("k").agg(
+        F.sum("v").alias("s")).filter(F.col("s") > 0)._plan)
+    assert not shape.registrable
+    assert shape.diagnostics[0][0] == "PLAN-MVIEW-SHAPE"
+
+    mem = inspect_plan(spark.createDataFrame(
+        [{"k": "a", "v": 1}]).groupBy("k").agg(
+        F.sum("v").alias("s"))._plan)
+    assert not mem.registrable
+    assert mem.diagnostics[0][0] == "PLAN-MVIEW-SOURCE"
+
+    # grouping key not carried through to the output
+    plan = scan_df.groupBy("k").agg(F.sum("v").alias("s"))._plan
+    keyless = dataclasses.replace(plan, aggregates=plan.aggregates[1:])
+    nk = inspect_plan(keyless)
+    assert nk.registrable and not nk.incremental
+    assert any(c == "PLAN-MVIEW-KEYS" for c, _, _ in nk.diagnostics)
+
+
+def test_registration_rides_on_cache(spark, mview_on, tmp_path):
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    assert spark.mview_manager.views() == []
+    df.cache()
+    try:
+        views = spark.mview_manager.views()
+        assert len(views) == 1 and views[0]["incremental"]
+    finally:
+        df.unpersist()
+    assert spark.mview_manager.views() == []
+
+
+def test_disabled_means_no_views(spark, tmp_path):
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        assert spark.mview_manager.views() == []
+    finally:
+        df.unpersist()
+
+
+# ---- freshness: the stale-cache hole this subsystem closes ------------------
+
+
+def test_view_refreshes_where_plain_cache_is_stale(spark, mview_on,
+                                                   tmp_path):
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        r1 = _rows(df)
+        view = spark.mview_manager.views()[0]
+        _write(d, "delta.parquet", ["k0", "zz"], [1000, 7])
+        r2 = _rows(df)
+        assert r2 != r1, "view must refresh after an append"
+        assert r2 == _rows(_sum_df(spark, d))  # == uncached recompute
+        view = spark.mview_manager.views()[0]
+        assert view["refreshes"] == 1
+        assert view["incremental_merges"] == 1
+        assert view["full_recomputes"] == 0
+        # unchanged source: fresh hit, no further refresh
+        assert _rows(df) == r2
+        assert spark.mview_manager.views()[0]["refreshes"] == 1
+    finally:
+        df.unpersist()
+
+
+def test_rewrite_forces_full_recompute(spark, mview_on, tmp_path):
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        _rows(df)
+        # rewrite base: not an append, merge would double-count
+        _base(d)
+        os.utime(os.path.join(d, "base.parquet"))
+        _write(d, "extra.parquet", ["k1"], [5])
+        assert _rows(df) == _rows(_sum_df(spark, d))
+        view = spark.mview_manager.views()[0]
+        assert view["full_recomputes"] == 1
+        assert view["incremental_merges"] == 0
+    finally:
+        df.unpersist()
+
+
+def test_eviction_then_refresh_recovers(spark, mview_on, tmp_path):
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        r1 = _rows(df)
+        with spark.memory_manager.lock:
+            spark.memory_store._evict_locked(1 << 62, floor=0,
+                                             reason="execution")
+        assert _rows(df) == r1  # re-materializes, not an error
+        _write(d, "post.parquet", ["k2"], [11])
+        assert _rows(df) == _rows(_sum_df(spark, d))
+    finally:
+        df.unpersist()
+
+
+# ---- byte identity: incremental on/off × devices × data shape ---------------
+
+
+class _FakeSession:
+    def __init__(self, conf):
+        self.conf = conf
+
+
+_MESHES = {}
+
+
+def _mesh(d):
+    from spark_tpu.parallel.mesh import make_mesh
+
+    if d not in _MESHES:
+        _MESHES[d] = make_mesh(d)
+    return _MESHES[d]
+
+
+def _sweep(spark, root, devices, incremental, agg_fn, steps,
+           key_type=pa.string()):
+    """One (devices, incremental) configuration: replay the identical
+    base+appends file evolution in a private dir through a standalone
+    CacheManager+ViewManager pair executing on a d-device mesh;
+    returns ([ipc_bytes per step], view_counters)."""
+    from spark_tpu.api.session import CacheManager
+    from spark_tpu.mview.manager import ViewManager
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.storage import MemoryStore, UnifiedMemoryManager
+
+    d = os.path.join(root, f"dev{devices}_{'on' if incremental else 'off'}")
+    os.makedirs(d)
+    _base(d, key_type)
+    conf = CF.RuntimeConf({"spark.tpu.mview.enabled": True,
+                           "spark.tpu.mview.incremental": incremental})
+    ex = MeshExecutor(_mesh(devices), conf=conf)
+    cm = CacheManager(store=MemoryStore(  # private store/budget
+        UnifiedMemoryManager(budget_bytes=1 << 30)))
+    mgr = ViewManager(_FakeSession(conf))
+    cm._mview = mgr
+    plan = agg_fn(spark.read.parquet(d))._plan
+    cm.add(plan)
+
+    def run(p):
+        return ex.execute_logical(p)
+
+    out = [table_to_ipc(to_arrow(cm.apply(plan, run).batch))]
+    for i, (ks, vs) in enumerate(steps):
+        _write(d, f"app{i}.parquet", ks, vs, key_type)
+        out.append(table_to_ipc(to_arrow(cm.apply(plan, run).batch)))
+    view = mgr.view_for(plan.structural_key())
+    return out, view
+
+
+UNIFORM = [([f"k{i % 13}" for i in range(50)], list(range(50))),
+           ([f"k{i % 13}" for i in range(60)], list(range(60)))]
+#: appends concentrated on one hot key plus NEW keys the base never
+#: saw (dictionary grows, merge capacity moves)
+SKEWED = [(["k0"] * 80 + ["new_a", "new_b"], list(range(82))),
+          (["k0"] * 70 + ["new_c"], list(range(71)))]
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.parametrize("devices", [1, 2, 8])
+@pytest.mark.parametrize("shape", ["uniform", "skewed"])
+def test_byte_identity_on_off_sweep(spark, tmp_path, devices, shape):
+    """The acceptance gate: for every device count and data shape, the
+    incremental path's serialized bytes equal the full-recompute
+    path's, step by step — and the incremental run actually merged."""
+    steps = UNIFORM if shape == "uniform" else SKEWED
+    agg = lambda df: df.groupBy("k").agg(  # noqa: E731
+        F.sum("v").alias("s"), F.max("v").alias("m"))
+    on, view_on = _sweep(spark, str(tmp_path), devices, True, agg, steps)
+    off, view_off = _sweep(spark, str(tmp_path), devices, False, agg,
+                           steps)
+    assert on == off, (
+        f"incremental vs recompute bytes diverge at devices={devices} "
+        f"shape={shape}")
+    assert view_on.incremental_merges == len(steps)
+    assert view_on.full_recomputes == 0
+    assert view_off.full_recomputes == len(steps)
+    assert view_off.incremental_merges == 0
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_byte_identity_integer_keys(spark, tmp_path, devices):
+    """Numeric group keys take the sort-path aggregate; identity must
+    hold there too."""
+    steps = [([i % 13 for i in range(50)], list(range(50))),
+             ([99, 99, 100], [1, 2, 3])]
+    agg = lambda df: df.groupBy("k").agg(  # noqa: E731
+        F.sum("v").alias("s"), F.min("v").alias("m"))
+    on, view_on = _sweep(spark, str(tmp_path), devices, True, agg,
+                         steps, key_type=pa.int64())
+    off, _ = _sweep(spark, str(tmp_path), devices, False, agg, steps,
+                    key_type=pa.int64())
+    assert on == off
+    assert view_on.incremental_merges == len(steps)
+
+
+# ---- non-mergeable plans fall back transparently ----------------------------
+
+
+def test_nonmergeable_avg_falls_back(spark, mview_on, tmp_path):
+    d = str(tmp_path)
+    _base(d)
+    df = spark.read.parquet(d).groupBy("k").agg(F.avg("v").alias("a"))
+    df.cache()
+    try:
+        views = spark.mview_manager.views()
+        assert len(views) == 1 and not views[0]["incremental"]
+        _rows(df)
+        _write(d, "delta.parquet", ["k0"], [12345])
+        assert _rows(df) == _rows(spark.read.parquet(d).groupBy("k")
+                                  .agg(F.avg("v").alias("a")))
+        view = spark.mview_manager.views()[0]
+        assert view["full_recomputes"] == 1
+        assert view["incremental_merges"] == 0
+    finally:
+        df.unpersist()
+
+
+def test_float_sum_falls_back(spark, mview_on, tmp_path):
+    d = str(tmp_path)
+    pq.write_table(pa.table({"k": pa.array(["a", "b", "a"]),
+                             "v": pa.array([1.5, 2.5, 3.5],
+                                           pa.float64())}),
+                   os.path.join(d, "f0.parquet"))
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        views = spark.mview_manager.views()
+        assert len(views) == 1 and not views[0]["incremental"]
+    finally:
+        df.unpersist()
+
+
+# ---- fault matrix: mview.refresh --------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["transient", "hang", "oom", "corrupt"])
+def test_refresh_fault_matrix(spark, mview_on, tmp_path, kind):
+    """One injected fault at the refresh seam: transient kinds retry
+    and the merge still lands; non-retryable kinds fall back to a full
+    recompute — in every case the query sees correct rows and no
+    error."""
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        _rows(df)
+        spark.conf.set("spark.tpu.faultInjection.hangSeconds", 0.02)
+        spark.conf.set("spark.tpu.faultInjection.mview.refresh",
+                       f"nth:1:{kind}")
+        faults.reset(spark.conf)
+        metrics.reset_mview()
+        _write(d, "delta.parquet", ["k0", "zz"], [1000, 7])
+        assert _rows(df) == _rows(_sum_df(spark, d))
+        assert faults.fire_count(spark.conf, "mview.refresh") == 1
+        st = metrics.mview_stats()
+        view = spark.mview_manager.views()[0]
+        if kind in ("transient", "hang"):
+            assert st["refresh_retries"] == 1
+            assert st["refresh_fallbacks"] == 0
+            assert view["incremental_merges"] == 1
+        else:
+            assert st["refresh_retries"] == 0
+            assert st["refresh_fallbacks"] == 1
+            assert view["full_recomputes"] == 1
+    finally:
+        df.unpersist()
+
+
+def test_refresh_retry_exhaustion_falls_back(spark, mview_on, tmp_path):
+    """Every attempt fails transiently: retries are bounded by
+    spark.tpu.mview.refreshRetries, then the refresh falls back to a
+    full recompute with correct bytes."""
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        _rows(df)
+        spark.conf.set("spark.tpu.mview.refreshRetries", 2)
+        spark.conf.set("spark.tpu.faultInjection.mview.refresh",
+                       "prob:1.0:7:transient")
+        faults.reset(spark.conf)
+        metrics.reset_mview()
+        _write(d, "delta.parquet", ["k1"], [55])
+        assert _rows(df) == _rows(_sum_df(spark, d))
+        assert faults.fire_count(spark.conf, "mview.refresh") == 3
+        st = metrics.mview_stats()
+        assert st["refresh_retries"] == 2
+        assert st["refresh_fallbacks"] == 1
+        assert spark.mview_manager.views()[0]["full_recomputes"] == 1
+    finally:
+        df.unpersist()
+
+
+# ---- streaming convergence --------------------------------------------------
+
+
+def _stream_setup(spark, tmp_path, name):
+    from spark_tpu.streaming import MemoryStream
+
+    src = MemoryStream(pa.schema([("k", pa.string()),
+                                  ("v", pa.int64())]))
+    agg = spark.readStream.load(src).groupBy("k").agg(
+        F.sum("v").alias("s"))
+    q = agg.writeStream.outputMode("complete").queryName(name) \
+        .option("checkpointLocation", str(tmp_path / "ck")).start()
+    return src, agg, q
+
+
+def test_stream_view_merges_micro_batches(spark, tmp_path):
+    src, agg, q = _stream_setup(spark, tmp_path, "mvs1")
+    mgr = spark.mview_manager
+    mgr.register_stream_view("sv1", agg._plan, "mvs1")
+    try:
+        src.add_data([{"k": "a", "v": 1}, {"k": "b", "v": 2}])
+        q.process_all_available()
+        src.add_data([{"k": "a", "v": 10}])
+        q.process_all_available()
+        got = _rows(mgr.read("sv1"))
+        assert got == [("a", 11), ("b", 2)]
+        view = mgr.stream_view("sv1")
+        assert view.incremental_merges == 2
+    finally:
+        q.stop()
+        mgr.drop_stream_view("sv1")
+
+
+def test_stream_view_replay_never_double_merges(spark, fconf_like,
+                                                tmp_path):
+    """Crash at the commit seam AFTER the view merged the delta: the
+    WAL replay redelivers the same batch id, which the watermark drops
+    — the view's sum counts every row exactly once."""
+    src, agg, q = _stream_setup(spark, tmp_path, "mvs2")
+    mgr = spark.mview_manager
+    mgr.register_stream_view("sv2", agg._plan, "mvs2")
+    try:
+        src.add_data([{"k": "a", "v": 5}])
+        q.process_all_available()
+        fconf_like.set("spark.tpu.faultInjection.streaming.commit",
+                       "nth:1:corrupt")
+        faults.reset(fconf_like)
+        src.add_data([{"k": "a", "v": 7}, {"k": "b", "v": 1}])
+        with pytest.raises(faults.InjectedCorruptionError):
+            q.process_all_available()
+        q.stop()
+        fconf_like.unset("spark.tpu.faultInjection.streaming.commit")
+        # the view already merged batch 2 (published pre-commit)
+        assert _rows(mgr.read("sv2")) == [("a", 12), ("b", 1)]
+        dedups0 = metrics.mview_stats()["stream_dedups"]
+
+        q2 = agg.writeStream.outputMode("complete").queryName("mvs2") \
+            .option("checkpointLocation", str(tmp_path / "ck")).start()
+        q2.process_all_available()  # WAL replay of the same batch id
+        q2.stop()
+        assert metrics.mview_stats()["stream_dedups"] == dedups0 + 1
+        assert _rows(mgr.read("sv2")) == [("a", 12), ("b", 1)], \
+            "replay must not double-merge"
+    finally:
+        mgr.drop_stream_view("sv2")
+
+
+@pytest.fixture
+def fconf_like(spark):
+    conf = spark.conf
+    faults.reset(conf)
+    yield conf
+    for key in ("spark.tpu.faultInjection.streaming.commit",
+                "spark.tpu.faultInjection.mview.refresh"):
+        try:
+            conf.unset(key)
+        except KeyError:
+            pass
+    faults.reset(conf)
+
+
+def test_stream_view_rejects_nonmergeable(spark, tmp_path):
+    from spark_tpu.streaming import MemoryStream
+
+    src = MemoryStream(pa.schema([("k", pa.string()),
+                                  ("v", pa.int64())]))
+    agg = spark.readStream.load(src).groupBy("k").agg(
+        F.avg("v").alias("a"))
+    with pytest.raises(ValueError):
+        spark.mview_manager.register_stream_view("bad", agg._plan, "x")
+
+
+# ---- store update accounting ------------------------------------------------
+
+
+class _FakeBatch:
+    def __init__(self, n):
+        self._n = n
+
+    def device_nbytes(self):
+        return self._n
+
+
+def test_memory_store_update_accounting():
+    from spark_tpu.storage import MemoryStore, UnifiedMemoryManager
+
+    m = UnifiedMemoryManager(budget_bytes=1 << 30)
+    store = MemoryStore(m)
+    assert store.put("v", _FakeBatch(1000))
+    assert store.bytes_used() == 1000
+    assert store.update("v", _FakeBatch(1500))
+    assert store.bytes_used() == 1500
+    assert store.update("v", _FakeBatch(300))
+    assert store.bytes_used() == 300
+    assert store.get("v").device_nbytes() == 300
+    # update of an absent key falls through to put
+    assert store.update("w", _FakeBatch(100))
+    assert store.bytes_used() == 400
+
+
+def test_memory_store_update_rejects_oversize_and_drops_stale():
+    from spark_tpu.storage import MemoryStore, UnifiedMemoryManager
+
+    m = UnifiedMemoryManager(budget_bytes=10_000)
+    store = MemoryStore(m)
+    assert store.put("v", _FakeBatch(1000))
+    assert not store.update("v", _FakeBatch(10**9))
+    # serving stale bytes is worse than recomputing: the entry is gone
+    assert store.get("v") is None
+    assert store.bytes_used() == 0
+
+
+# ---- serve-tier repopulation ------------------------------------------------
+
+
+def test_serve_cache_repopulated_after_refresh(spark, mview_on,
+                                               tmp_path):
+    from spark_tpu.serve import result_cache as rc
+
+    d = str(tmp_path)
+    _base(d)
+    spark.conf.set("spark.tpu.serve.resultCache.enabled", "true")
+    spark.serve_result_cache = rc.ResultCache(spark.conf)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        _rows(df)
+        _write(d, "delta.parquet", ["k3", "q"], [42, 9])
+        expected = df.toArrow()
+        key = rc.plan_result_key(df._plan)
+        blob = spark.serve_result_cache.lookup(key)
+        assert blob is not None, "refresh must pre-warm the serve cache"
+        assert blob == rc.table_to_ipc(expected), \
+            "repopulated bytes must equal what serving would produce"
+        assert metrics.mview_stats()["serve_repopulations"] >= 1
+    finally:
+        df.unpersist()
+        spark.conf.unset("spark.tpu.serve.resultCache.enabled")
+        del spark.serve_result_cache
+
+
+# ---- diagnostics + conf + observability -------------------------------------
+
+
+def test_plan_mview_diagnostics_via_analyze(spark, tmp_path):
+    from spark_tpu.analysis import analyze
+
+    d = str(tmp_path)
+    _base(d)
+    ok = analyze(_sum_df(spark, d)._plan, spark.conf)
+    assert any(dg.code == "PLAN-MVIEW-OK" for dg in ok.diagnostics)
+    rec = analyze(spark.read.parquet(d).groupBy("k")
+                  .agg(F.avg("v").alias("a"))._plan, spark.conf)
+    assert any(dg.code == "PLAN-MVIEW-RECOMPUTE"
+               for dg in rec.diagnostics)
+
+
+def test_conf_keys_registered():
+    assert CF.MVIEW_ENABLED.key == "spark.tpu.mview.enabled"
+    assert CF.MVIEW_ENABLED.default is False
+    assert CF.MVIEW_INCREMENTAL.default is True
+    assert CF.MVIEW_REFRESH_RETRIES.default == 2
+    assert CF.MVIEW_SERVE_REPOPULATE.default is True
+    assert "mview.refresh" in faults.POINTS
+
+
+def test_mview_profile_renders(spark, mview_on, tmp_path):
+    from spark_tpu import tracing
+
+    d = str(tmp_path)
+    _base(d)
+    df = _sum_df(spark, d)
+    df.cache()
+    try:
+        _rows(df)
+        _write(d, "delta.parquet", ["k5"], [3])
+        _rows(df)
+        text = tracing.format_mview_profile()
+        assert "incremental" in text
+        prof = tracing.mview_profile()
+        assert prof["totals"]["incremental_merges"] >= 1
+    finally:
+        df.unpersist()

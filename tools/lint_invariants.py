@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST linter for spark_tpu codebase invariants.
 
-Four rules the engine relies on but Python cannot enforce:
+Five rules the engine relies on but Python cannot enforce:
 
 1. **conf-keys** — every string key passed to ``conf.get(...)`` /
    ``conf.set(...)`` (and builder ``.config(...)``) that looks like a
@@ -29,6 +29,13 @@ Four rules the engine relies on but Python cannot enforce:
    lexically inside ``with _LOCK:`` (``_PATH_CACHE`` under
    ``_IO_LOCK``); the concurrent scheduler serves queries from many
    threads and an unlocked append corrupts the ring.
+
+5. **dead-fault-points** — the converse of rule 2: every point
+   declared in ``faults.POINTS`` must have at least one
+   ``faults.inject("<point>", ...)`` call site under the linted
+   paths. A declared-but-never-injected point registers a conf key
+   and documents a recovery seam that does not exist — fault suites
+   arming it would silently test nothing.
 
 Run as a CLI (exit 0 clean / 1 findings) or import ``run_lint()``;
 tests/test_analysis.py runs it as a test so CI enforces it. Optional
@@ -139,8 +146,8 @@ def _check_conf_keys(tree: ast.AST, rel: str, cfg: dict,
 # ---- rule 2: fault points ---------------------------------------------------
 
 
-def _check_fault_points(tree: ast.AST, rel: str,
-                        out: List[Finding]) -> None:
+def _check_fault_points(tree: ast.AST, rel: str, out: List[Finding],
+                        seen: Optional[Set[str]] = None) -> None:
     from spark_tpu import faults
 
     valid: Set[str] = set(faults.POINTS)
@@ -153,11 +160,29 @@ def _check_fault_points(tree: ast.AST, rel: str,
         if name != "inject":
             continue
         point = _const_str(node.args[0])
-        if point is not None and point not in valid:
+        if point is None:
+            continue
+        if point not in valid:
             out.append(Finding(
                 "fault-points", rel, node.lineno,
                 f"fault point {point!r} is not in faults.POINTS — "
                 "this injection site can never fire"))
+        elif seen is not None:
+            seen.add(point)
+
+
+def _check_dead_fault_points(seen: Set[str],
+                             out: List[Finding]) -> None:
+    """Rule 5: every declared point must be injectable somewhere."""
+    from spark_tpu import faults
+
+    for point in sorted(set(faults.POINTS) - seen):
+        out.append(Finding(
+            "dead-fault-points",
+            os.path.join("spark_tpu", "faults.py"), 0,
+            f"fault point {point!r} is declared in faults.POINTS but "
+            "has no faults.inject(...) call site under the linted "
+            "paths — arming it would silently test nothing"))
 
 
 # ---- rule 3: fingerprint purity ---------------------------------------------
@@ -311,6 +336,7 @@ def run_lint(config: Optional[dict] = None) -> List[Finding]:
     findings: List[Finding] = []
     fingerprint: Dict[str, List[str]] = dict(cfg["fingerprint_paths"])
     locked = set(cfg["locked_modules"])
+    injected_points: Set[str] = set()
     for path in _iter_py_files(cfg):
         rel = os.path.relpath(path, REPO_ROOT)
         with open(path, "r") as f:
@@ -322,12 +348,13 @@ def run_lint(config: Optional[dict] = None) -> List[Finding]:
                                     f"syntax error: {e.msg}"))
             continue
         _check_conf_keys(tree, rel, cfg, findings)
-        _check_fault_points(tree, rel, findings)
+        _check_fault_points(tree, rel, findings, injected_points)
         if rel in fingerprint:
             _check_fingerprint_purity(tree, rel, fingerprint[rel],
                                       findings)
         if rel in locked:
             _check_metrics_locks(tree, rel, cfg, findings)
+    _check_dead_fault_points(injected_points, findings)
     return findings
 
 
