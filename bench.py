@@ -139,6 +139,12 @@ WARMUP_MODE = os.environ.get("BENCH_WARMUP", "1") == "1"
 # 'mview' in the result JSON)
 MVIEW_MODE = os.environ.get("BENCH_MVIEW", "1") == "1"
 
+# BENCH_AGG=0 skips the adaptive-aggregation A/B (low-NDV / high-NDV /
+# skewed group-bys, spark.tpu.adaptive.agg.enabled off vs on; timing +
+# byte-identity digest + per-strategy pick counts land under 'agg' in
+# the result JSON; needs BENCH_MASTER=mesh[N] to engage)
+AGG_MODE = os.environ.get("BENCH_AGG", "1") == "1"
+
 
 def _warmup_child() -> None:
     """Subprocess entry for the cold-start A/B (BENCH_WARMUP_CHILD=1):
@@ -875,6 +881,27 @@ def main():
                    "mview": mview,
                    "robustness": _robustness_counters()})
 
+    agg_ab = None
+    if AGG_MODE:
+        if _wall_remaining() <= 5:
+            agg_ab = {"error": "skipped: wall budget exhausted",
+                      "phase": "agg"}
+        else:
+            print("[bench] agg A/B: low/high-NDV + skewed group-bys, "
+                  "spark.tpu.adaptive.agg.enabled off vs on",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_query_deadline()):
+                    agg_ab = _run_agg_ab(spark)
+            except _QueryTimeout:
+                agg_ab = {"error": "timeout"}
+            except Exception as e:
+                agg_ab = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "agg": agg_ab,
+                   "robustness": _robustness_counters()})
+
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
     # headline number stays meaningful (flagged via queries_failed)
@@ -910,6 +937,7 @@ def main():
         **({"serving": serving} if serving is not None else {}),
         **({"serve": serve_ab} if serve_ab is not None else {}),
         **({"mview": mview} if mview is not None else {}),
+        **({"agg": agg_ab} if agg_ab is not None else {}),
         **({"analysis": analysis_overhead}
            if analysis_overhead is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}
@@ -1015,6 +1043,89 @@ def _run_adaptive_compare(spark) -> dict:
                 "aqe": on_sh.get("aqe", []),
             }
     finally:
+        conf.unset("spark.tpu.adaptive.enabled")
+    return out
+
+
+def _run_agg_ab(spark) -> dict:
+    """Adaptive-aggregation A/B: the three key distributions the
+    strategy switch discriminates — low NDV (hash-partial territory),
+    high NDV ~ rows (partial-bypass: pre-aggregation shrinks nothing,
+    the static plan pays a full sort-agg for zero reduction), and
+    skewed (the sketch sees through the hot key) — each timed with
+    adaptive execution off (the static partial->final plan, exchanges
+    fused at worst-case capacity) then fully on (AQE + the aggregation
+    strategy switch). Results must be byte-identical; the JSON records the
+    digest, per-strategy pick counts (metrics.agg_stats delta), and
+    the measured NDV/rows ratio per workload. Skipped on single-device
+    sessions (run with BENCH_MASTER=mesh[N] to engage)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import metrics
+    from spark_tpu.api import functions as F
+
+    if getattr(spark, "_mesh", None) is None:
+        return {"skipped": "single-device session (no mesh): no "
+                           "partial->final split to adapt"}
+    rng = np.random.default_rng(7)
+    n = int(os.environ.get("BENCH_AGG_ROWS", "120000"))
+    workloads = {
+        "low_ndv": rng.integers(0, 64, n),
+        "high_ndv": rng.permutation(n).astype(np.int64),
+        "skewed": np.where(rng.random(n) < 0.9, 7,
+                           rng.integers(0, 100000, n)),
+    }
+    out = {}
+    conf = spark.conf
+    try:
+        for name, keys in workloads.items():
+            tbl = pa.table({
+                "k": pa.array(keys, pa.int64()),
+                "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+            })
+            df = (spark.createDataFrame(tbl).groupBy("k")
+                  .agg(F.sum("v").alias("s"), F.count("v").alias("c"),
+                       F.min("v").alias("mn"), F.max("v").alias("mx"))
+                  .orderBy("k"))
+
+            def timed(adaptive_on, agg_on):
+                conf.set("spark.tpu.adaptive.enabled", adaptive_on)
+                conf.set("spark.tpu.adaptive.agg.enabled", agg_on)
+                df.toArrow()  # warm-up: compile off the clock
+                before = metrics.agg_stats()
+                t0 = time.perf_counter()
+                got = df.toArrow()
+                ms = (time.perf_counter() - t0) * 1000.0
+                picks = {k: v - before.get(k, 0)
+                         for k, v in metrics.agg_stats().items()
+                         if v - before.get(k, 0)}
+                return got, round(ms, 1), picks
+
+            # three arms: fully static plan / AQE with the static
+            # partial->final strategy / AQE + the strategy switch — so
+            # the switch's own contribution is visible on top of the
+            # capacity-compaction win AQE already provides
+            off_tbl, off_ms, _ = timed(False, False)
+            _, aqe_ms, _ = timed(True, False)
+            on_tbl, on_ms, picks = timed(True, True)
+            ev = next((e for e in reversed(metrics.recent(256))
+                       if e.get("kind") == "agg"), {})
+            out[name] = {
+                "rows": n,
+                "off_ms": off_ms,
+                "aqe_only_ms": aqe_ms,
+                "on_ms": on_ms,
+                "speedup": round(off_ms / on_ms, 2) if on_ms else None,
+                "speedup_vs_aqe": (round(aqe_ms / on_ms, 2)
+                                   if on_ms else None),
+                "byte_identical": bool(on_tbl.equals(off_tbl)),
+                "strategy_picks": picks,
+                "ndv_estimate": ev.get("ndv"),
+                "ndv_ratio": ev.get("ratio"),
+            }
+    finally:
+        conf.unset("spark.tpu.adaptive.agg.enabled")
         conf.unset("spark.tpu.adaptive.enabled")
     return out
 

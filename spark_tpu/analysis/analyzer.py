@@ -80,6 +80,20 @@ def _legality_diags(plan: L.LogicalPlan,
                              f"{va.reason}"),
                     hint=("the chunked out-of-HBM tier will execute "
                           f"this aggregate directly ({va.offending})")))
+            try:
+                vs = legality.strategy_verdict(node.aggregates,
+                                               node.child.schema)
+            except Exception:
+                vs = legality.OK
+            if not vs.ok:
+                diags.append(Diagnostic(
+                    code="PLAN-AGG-STRATEGY", level="info",
+                    node=node.node_string(),
+                    message=("adaptive aggregation pinned to the "
+                             f"partial->final strategy: {vs.reason}"),
+                    hint=("the runtime strategy switch (partial-bypass "
+                          "/ hash-partial) is skipped for this "
+                          f"aggregate ({vs.offending})")))
         for c in node.children():
             go(c)
 

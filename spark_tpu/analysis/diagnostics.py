@@ -38,6 +38,11 @@ Diagnostic codes (stable API — tests and deployments key on these):
                             mergeable accumulators (DISTINCT,
                             unsupported call): chunked/streaming
                             tiers execute it directly
+- ``PLAN-AGG-STRATEGY``     the runtime-adaptive aggregation engine
+                            cannot switch strategies for this
+                            aggregate (float Sum/Min/Max partials are
+                            order-dependent): it stays pinned to the
+                            static partial->final path
 - ``PLAN-ANALYZE-FAIL``     the analyzer itself failed on this plan
                             (reported, never raised)
 

@@ -26,6 +26,20 @@ Two distinct legality questions:
   Required by the chunked out-of-HBM tier and streaming state merge.
   This is purely structural — merging partials happens exactly once,
   so float Sum is fine here (same additions, same order class).
+
+- **strategy flexibility** (``strategy_verdict``): may the adaptive
+  aggregation engine switch this aggregate between the partial→final,
+  partial-bypass, and hash-partial strategies byte-identically?
+  Switching changes WHICH rows each accumulator sees before the merge
+  (bypass merges raw rows instead of per-device partials; hash groups
+  in packed-code order instead of sort order), so every partial
+  accumulator must be partition- and order-invariant: Count always is;
+  Sum/Avg need an integral partial sum (int64 wraparound is
+  associative, and decimals are scaled int64 — float rounding is not);
+  Min/Max must be non-float (-0.0/NaN selection). An aggregate that
+  fails is pinned to the static partial→final strategy — execution
+  stays correct, just not adaptive. The analyzer reports this as
+  PLAN-AGG-STRATEGY.
 """
 
 from __future__ import annotations
@@ -185,6 +199,63 @@ def accumulators_verdict(aggregates) -> Verdict:
     for e in aggregates:
         for call in E.collect_aggregates(e):
             v = accumulator_verdict(call)
+            if not v.ok:
+                return v
+    return OK
+
+
+def strategy_call_verdict(call: E.Expression, schema) -> Verdict:
+    """Strategy flexibility for ONE aggregate call over ``schema``
+    (the pre-aggregation input rows). OK means the runtime may compute
+    this call's partial accumulators under ANY partitioning/grouping
+    order (bypass, hash, sort) and merge to byte-identical results."""
+    v = accumulator_verdict(call)
+    if not v.ok:
+        return v
+    if isinstance(call, E.Count):
+        return OK  # int64 counting is exact under any row order
+    if isinstance(call, (E.Sum, E.Avg)):
+        # the decomposition's partial is Sum(child) (AggSpec): its
+        # accumulator dtype decides exactness, so decimal Avg (scaled
+        # int64 sum + int64 count -> deterministic finalize) passes
+        try:
+            dt = _np_dtype(E.Sum(call.child).data_type(schema))
+        except Exception:
+            return Verdict(
+                False, "PLAN-AGG-STRATEGY",
+                "cannot resolve the partial Sum accumulator dtype",
+                str(call))
+        if not (np.issubdtype(dt, np.integer) or dt == np.bool_):
+            return Verdict(
+                False, "PLAN-AGG-STRATEGY",
+                "float Sum partials are order-dependent (float "
+                "addition is not associative); strategy switching "
+                "would change rounding", str(call))
+        return OK
+    # Min/Max: same dtype discipline as the exact re-merge rule
+    try:
+        dt = _np_dtype(call.data_type(schema))
+    except Exception:
+        return Verdict(
+            False, "PLAN-AGG-STRATEGY",
+            "cannot resolve the Min/Max accumulator dtype", str(call))
+    if np.issubdtype(dt, np.floating):
+        return Verdict(
+            False, "PLAN-AGG-STRATEGY",
+            "float Min/Max selection is order-dependent (-0.0 vs 0.0 "
+            "and NaN)", str(call))
+    return OK
+
+
+def strategy_verdict(aggregates, schema) -> Verdict:
+    """Strategy flexibility over a whole aggregate list: every
+    aggregate call must individually qualify. Works on both logical
+    output expressions (the analyzer) and already-decomposed physical
+    partial aliases (the distributed executor) — both reduce to the
+    same set of Count/Sum/Avg/Min/Max calls over the input schema."""
+    for e in aggregates:
+        for call in E.collect_aggregates(e):
+            v = strategy_call_verdict(call, schema)
             if not v.ok:
                 return v
     return OK

@@ -265,6 +265,50 @@ ADAPTIVE_SKEW_MIN_ROWS = register(
     "— same reason the reference pairs its factor with "
     "SKEW_JOIN_SKEWED_PARTITION_THRESHOLD).", int)
 
+ADAPTIVE_AGG_ENABLED = register(
+    "spark.tpu.adaptive.agg.enabled", True,
+    "Runtime-adaptive aggregation strategy switching (only active when "
+    "spark.tpu.adaptive.enabled is also on): the exchange stats stage "
+    "additionally sketches the distinct group-key count (HLL-style "
+    "register maxima, one extra pmax fetch) and the executor picks "
+    "between the static partial->final path, partial-bypass (NDV ~ "
+    "rows: skip the useless pre-aggregation, exchange raw rows by "
+    "key), and a hash-partial over runtime-measured packed key codes. "
+    "Results are byte-identical across strategies; aggregates whose "
+    "partials are order-dependent (float Sum/Min/Max) are pinned to "
+    "partial->final (see analysis PLAN-AGG-STRATEGY).", bool)
+
+ADAPTIVE_AGG_STRATEGY = register(
+    "spark.tpu.adaptive.agg.strategy", "auto",
+    "Aggregation strategy override: 'auto' decides from the runtime "
+    "sketch; 'partial', 'bypass', or 'hash' force one strategy (an "
+    "illegal forced choice falls back to 'partial' so results stay "
+    "byte-identical). Test/debug knob.", str)
+
+ADAPTIVE_AGG_BYPASS_NDV_RATIO = register(
+    "spark.tpu.adaptive.agg.bypassNdvRatio", 0.5,
+    "Partial-bypass threshold: when the sketched distinct-key estimate "
+    "is at least this fraction of the live row count, pre-aggregation "
+    "cannot shrink the exchange enough to pay for itself (the "
+    "all-distinct pathology of 'Partial Partial Aggregates'), so raw "
+    "rows exchange straight to the final aggregate.", float)
+
+ADAPTIVE_AGG_HASH_DOMAIN_LIMIT = register(
+    "spark.tpu.adaptive.agg.hashDomainLimit", 1024,
+    "Max packed key-code domain (product of measured per-key value "
+    "ranges, nulls included) for the hash-partial strategy: the dense "
+    "segment accumulator must fit the measured selection table (<= 64 "
+    "XLA fused, 64 < K <= 1024 Pallas one-pass; see ops/pallas_agg.py)."
+    " Beyond it the sort-based partial wins.", int)
+
+ADAPTIVE_AGG_SKETCH_REGISTERS = register(
+    "spark.tpu.adaptive.agg.sketchRegisters", 512,
+    "HyperLogLog-style register count for the group-key distinct "
+    "sketch in the exchange stats stage (power of two). 512 registers "
+    "give ~5% relative error — plenty to separate 'NDV ~ rows' from "
+    "'NDV << rows' — and ride the existing stats fetch as one extra "
+    "O(registers) int vector.", int)
+
 SEARCHSORTED_SORT_THRESHOLD = register(
     "spark.tpu.kernels.searchsortedSortThreshold", 50,
     "physical/kernels.searchsorted picks XLA's O((n+m)log(n+m)) "

@@ -44,6 +44,14 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          (file views) or re-raises so the streaming
                          WAL replay redelivers the delta (stream
                          views) — bytes stay identical either way
+- ``agg.strategy``       the adaptive aggregation strategy decision
+                         (parallel/executor.py), fired between the
+                         sketch fetch and the strategy pick: ANY kind
+                         (transient/oom/hang/corrupt) is absorbed by
+                         falling back to the static partial->final
+                         strategy — the sketch is advisory, its result
+                         is discarded on failure, so even a 'corrupt'
+                         sketch cannot change bytes
 
 Spec grammar (the conf value):
 
@@ -97,6 +105,7 @@ POINTS = (
     "compile.background",
     "serve.dispatch",
     "mview.refresh",
+    "agg.strategy",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

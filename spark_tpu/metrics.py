@@ -264,6 +264,35 @@ def reset_serve() -> None:
             _SERVE[k] = 0
 
 
+# ---- adaptive-aggregation counters ------------------------------------------
+
+#: the runtime-adaptive aggregation engine (parallel/executor.py) —
+#: per-strategy pick counts (the static partial->final path, the
+#: partial-bypass raw-row exchange, the measured hash-partial),
+#: strategy pins forced by legality (order-dependent float partials),
+#: sketch failures absorbed by falling back to partial->final, and how
+#: many decisions ran with a forced conf override. Shown in
+#: tracing.aggregation_profile and /api/v1/agg.
+_AGG = {"partial": 0, "bypass": 0, "hash": 0, "pinned": 0,
+        "sketch_failures": 0, "forced": 0}
+
+
+def note_agg(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _AGG[kind] = _AGG.get(kind, 0) + int(n)
+
+
+def agg_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_AGG)
+
+
+def reset_agg() -> None:
+    with _LOCK:
+        for k in list(_AGG):
+            _AGG[k] = 0
+
+
 # ---- materialized-view counters ---------------------------------------------
 
 #: the incremental materialized-view engine (spark_tpu/mview/) —

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: one-pass segmented (grouped) sum.
+"""Pallas TPU kernels: one-pass segmented (grouped) reductions.
 
 The hot aggregation path in physical/kernels.py handles small group
 counts with K masked dense reductions (`_masked_reduce`) — K full passes
@@ -34,6 +34,16 @@ HLO's: 28 s at K=1024, 64 s at K=2048, vs ~1 s flat for this kernel.
 Selection encoded in physical/kernels.py: K <= 64 XLA fused (compile
 stays sub-second), 64 < K <= 1024 this kernel on TPU (avoids both the
 scatter cliff and multi-second compiles), else scatter/sort paths.
+
+Accumulator family (same tiling, same selection table): Sum
+(``pallas_seg_sum``), Count (``maybe_pallas_seg_count`` — the sum
+kernel over the mask with an exact-int epilogue), Min/Max
+(``pallas_seg_minmax`` — sentinel-carried instead of zero-carried, so
+masked-out rows and lane padding cannot win the reduction), and Mean
+(``maybe_pallas_seg_mean`` — sum/count composition, two passes sharing
+the tile layout). Min/Max measure within a few percent of the sum
+kernel at equal K: the inner loop swaps an add for a select-compare,
+both lane-parallel.
 """
 
 from __future__ import annotations
@@ -141,6 +151,80 @@ def pallas_seg_sum(data: jnp.ndarray, seg: jnp.ndarray,
     return acc.sum(axis=1)
 
 
+def _minmax_kernel(seg_ref, data_ref, mf_ref, acc_ref, *,
+                   num_segments: int, is_max: bool):
+    """One grid step of the segmented min/max: masked-out rows carry the
+    identity sentinel (not zero — zero would win min over positives),
+    so padding and dead rows can never beat a live value."""
+    from jax.experimental import pallas as pl
+
+    ident = jnp.float32(-jnp.inf if is_max else jnp.inf)
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        acc_ref[:] = jnp.full_like(acc_ref, ident)
+
+    seg = seg_ref[:]                      # (B, 128) int32
+    data = data_ref[:]                    # (B, 128) f32
+    live = mf_ref[:] > 0                  # (B, 128) bool
+    pick = jnp.maximum if is_max else jnp.minimum
+
+    def body(k, carry):
+        sel = live & (seg == k)                        # (B, 128)
+        cand = jnp.where(sel, data, ident)
+        if is_max:
+            part = jnp.max(cand, axis=0, keepdims=True)
+        else:
+            part = jnp.min(cand, axis=0, keepdims=True)
+        prev = acc_ref[pl.ds(k, 1), :]
+        acc_ref[pl.ds(k, 1), :] = pick(prev, part)
+        return carry
+
+    jax.lax.fori_loop(0, num_segments, body, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "is_max",
+                                    "interpret"))
+def pallas_seg_minmax(data: jnp.ndarray, seg: jnp.ndarray,
+                      mask: jnp.ndarray, num_segments: int,
+                      is_max: bool = False,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Grouped min (or max) of ``data`` (1-D) by segment id, one pass
+    over HBM. Groups with no live row yield the identity (+inf for min,
+    -inf for max) — same convention as the XLA kernels' sentinel, so
+    the caller's empty-group handling is path-independent."""
+    from jax.experimental import pallas as pl
+
+    n = data.shape[0]
+    block = _BLOCK_ROWS * _LANES
+    pad = (-n) % block
+    f32 = jnp.float32
+    d = jnp.pad(data.astype(f32), (0, pad))
+    s = jnp.pad(seg.astype(jnp.int32), (0, pad),
+                constant_values=num_segments)  # out of range: ignored
+    m = jnp.pad(mask.astype(f32), (0, pad))
+    rows = (n + pad) // _LANES
+    d2 = d.reshape(rows, _LANES)
+    s2 = s.reshape(rows, _LANES)
+    m2 = m.reshape(rows, _LANES)
+    grid = rows // _BLOCK_ROWS
+
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    acc = pl.pallas_call(
+        functools.partial(_minmax_kernel, num_segments=num_segments,
+                          is_max=is_max),
+        grid=(grid,),
+        in_specs=[spec, spec, spec],
+        out_specs=pl.BlockSpec((num_segments, _LANES), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_segments, _LANES), f32),
+        interpret=interpret,
+    )(s2, d2, m2)
+    # cross-lane reduce outside the kernel: sentinel lanes lose
+    return acc.max(axis=1) if is_max else acc.min(axis=1)
+
+
 # engine-side selection bound: below this the XLA fused multi-reduce
 # compiles fast and runs faster (see measurement table above)
 MIN_ENGINE_K = 64
@@ -170,3 +254,45 @@ def maybe_pallas_seg_count(seg, mask, num_segments: int):
     ones = mask.astype(jnp.float32)
     return pallas_seg_sum(ones, seg, mask, num_segments,
                           interpret=interpret, exact_int=True)
+
+
+def maybe_pallas_seg_min(data, seg, mask, num_segments: int):
+    """Engine entry point for float32 grouped min: Pallas when it
+    qualifies, else None. Empty groups come back +inf, matching the
+    XLA sentinel convention in physical/kernels.seg_min."""
+    if num_segments <= MIN_ENGINE_K or \
+            not pallas_available(data.dtype, num_segments):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    return pallas_seg_minmax(data, seg, mask, num_segments,
+                             is_max=False, interpret=interpret)
+
+
+def maybe_pallas_seg_max(data, seg, mask, num_segments: int):
+    """Engine entry point for float32 grouped max (empty groups -inf)."""
+    if num_segments <= MIN_ENGINE_K or \
+            not pallas_available(data.dtype, num_segments):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    return pallas_seg_minmax(data, seg, mask, num_segments,
+                             is_max=True, interpret=interpret)
+
+
+def maybe_pallas_seg_mean(data, seg, mask, num_segments: int):
+    """Engine entry point for float32 grouped mean: sum and count from
+    the same tiled kernels (two passes), divided outside. Empty groups
+    yield NaN (0/0 guarded to 0-count -> NaN via where), which callers
+    mask with their own validity. None when the path doesn't qualify."""
+    if num_segments <= MIN_ENGINE_K or \
+            not pallas_available(data.dtype, num_segments):
+        return None
+    if seg.shape[0] >= (1 << 31):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    s = pallas_seg_sum(data, seg, mask, num_segments,
+                       interpret=interpret)
+    c = pallas_seg_sum(mask.astype(jnp.float32), seg, mask,
+                       num_segments, interpret=interpret,
+                       exact_int=True)
+    return jnp.where(c > 0, s / jnp.maximum(c, 1).astype(jnp.float32),
+                     jnp.float32(jnp.nan))

@@ -45,13 +45,15 @@ def axis_size() -> int:
 # ---- row routing ------------------------------------------------------------
 
 
-def hash_target(tvs: Sequence[TV], mask: jnp.ndarray, d: int) -> jnp.ndarray:
-    """Device id per row = avalanche hash of the key columns mod D
-    (HashPartitioning analogue, reference:
-    exchange/ShuffleExchangeExec.scala:275). Dictionary codes hash
-    directly — dictionaries are global constants, so codes agree across
-    devices. NULL hashes as a fixed sentinel, so null keys co-locate."""
-    cap = int(mask.shape[0])
+def hash_rows(tvs: Sequence[TV]) -> jnp.ndarray:
+    """Full-width avalanche hash of the key columns, one uint64 per
+    row. Dictionary codes hash directly — dictionaries are global
+    constants, so codes agree across devices. NULL hashes as a fixed
+    sentinel, so null keys collide (and co-locate once routed). Shared
+    by hash routing (mod D) and the distinct-key sketch (register
+    index + leading-zero rank over the SAME hash chain, so equal keys
+    produce equal registers on every device)."""
+    cap = int(tvs[0].data.shape[0]) if tvs else 0
     h = jnp.zeros((cap,), dtype=jnp.uint64)
     for tv in tvs:
         data = tv.data
@@ -65,7 +67,16 @@ def hash_target(tvs: Sequence[TV], mask: jnp.ndarray, d: int) -> jnp.ndarray:
             code = jnp.where(tv.validity, code,
                              jnp.uint64(0xA5A5A5A5A5A5A5A5))
         h = K.hash_combine(h, code)
-    return (h % jnp.uint64(d)).astype(jnp.int32)
+    return h
+
+
+def hash_target(tvs: Sequence[TV], mask: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Device id per row = avalanche hash of the key columns mod D
+    (HashPartitioning analogue, reference:
+    exchange/ShuffleExchangeExec.scala:275)."""
+    if not tvs:
+        return jnp.zeros((int(mask.shape[0]),), dtype=jnp.int32)
+    return (hash_rows(tvs) % jnp.uint64(d)).astype(jnp.int32)
 
 
 def range_target(key: TV, ascending: bool, nulls_first: bool, d: int,

@@ -122,7 +122,10 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
     def schema(self) -> Schema:
         return self.child.schema
 
-    def _target(self, pipe: Pipe, d: int) -> jnp.ndarray:
+    def _key_tvs(self, pipe: Pipe) -> List[TV]:
+        """Key columns after union-dictionary translation — the exact
+        values routing hashes over (also what the stats stage sketches
+        and measures, so decisions see what the exchange will see)."""
         env = pipe.env()
         tvs = [C.evaluate(k, env) for k in self.keys]
         if self.key_union_dicts is not None:
@@ -136,7 +139,10 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
                             tv.dtype, union)
                 translated.append(tv)
             tvs = translated
-        target = X.hash_target(tvs, pipe.mask, d)
+        return tvs
+
+    def _target(self, pipe: Pipe, d: int) -> jnp.ndarray:
+        target = X.hash_target(self._key_tvs(pipe), pipe.mask, d)
         if self.fan_destinations:
             target = X.fan_local(target, self.fan_destinations)
         return target
@@ -238,9 +244,27 @@ class ExchangeStatsExec(P.PhysicalPlan):
     send cell). One tiny SPMD stage, one host fetch of 2*d int64s —
     the MapOutputStatistics of this engine (reference:
     MapOutputTrackerMaster.getStatistics, consumed by
-    AdaptiveSparkPlanExec between stages)."""
+    AdaptiveSparkPlanExec between stages).
+
+    Optional extensions riding the same stage + fetch (hash exchanges
+    only; both default off so existing uses measure exactly as before):
+
+    - ``sketch_registers`` > 0 adds ``__ndvreg``: HyperLogLog-style
+      register maxima over the exchange keys. Register index and rank
+      come from the SAME full-width hash chain routing uses (minus the
+      mod-D), ranks seg-max locally (through the measured selection
+      table — 64 < R <= 1024 rides the Pallas one-pass kernel on TPU)
+      and pmax across the mesh; the host turns register maxima into a
+      distinct-key estimate. One extra O(registers) int vector.
+    - ``key_stats`` > 0 adds ``__kmin``/``__kmax``/``__knull``: global
+      per-key value min/max (pmin/pmax) and a nulls-present flag over
+      the translated key columns — the measured packed-code domain for
+      the hash-partial aggregation strategy.
+    """
 
     exchange: P.PhysicalPlan  # Hash/RoundRobin/Range exchange exec
+    sketch_registers: int = 0    # power of two; 0 = no distinct sketch
+    key_stats: int = 0           # number of keys to min/max; 0 = none
     traceable = True
 
     def children(self):
@@ -248,8 +272,15 @@ class ExchangeStatsExec(P.PhysicalPlan):
 
     @property
     def schema(self) -> Schema:
-        return Schema((Field("__incoming", T.INT64, nullable=False),
-                       Field("__maxslice", T.INT64, nullable=False)))
+        fields = [Field("__incoming", T.INT64, nullable=False),
+                  Field("__maxslice", T.INT64, nullable=False)]
+        if self.sketch_registers:
+            fields.append(Field("__ndvreg", T.INT64, nullable=False))
+        if self.key_stats:
+            fields.append(Field("__kmin", T.INT64, nullable=False))
+            fields.append(Field("__kmax", T.INT64, nullable=False))
+            fields.append(Field("__knull", T.INT64, nullable=False))
+        return Schema(tuple(fields))
 
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
         pipe = child_pipes[0]
@@ -259,19 +290,77 @@ class ExchangeStatsExec(P.PhysicalPlan):
                             pipe.mask, d)
         incoming = X.psum(local).astype(jnp.int64)
         maxslice = X.pmax(local).astype(jnp.int64)
+
+        cap = max(d, self.sketch_registers or 0, self.key_stats or 0)
+
+        def padded(v):
+            return jnp.pad(v.astype(jnp.int64), (0, cap - v.shape[0]))
+
+        cols = {"__incoming": TV(padded(incoming), None, T.INT64, None),
+                "__maxslice": TV(padded(maxslice), None, T.INT64, None)}
+        order = ["__incoming", "__maxslice"]
+
+        if self.sketch_registers or self.key_stats:
+            key_tvs = self.exchange._key_tvs(pipe)
+
+        if self.sketch_registers:
+            r = int(self.sketch_registers)
+            p = r.bit_length() - 1          # r = 2**p (validated by caller)
+            h = X.hash_rows(key_tvs)
+            idx = (h & jnp.uint64(r - 1)).astype(jnp.int32)
+            w = h >> jnp.uint64(p)
+            # rank = leading zeros of the (64-p)-bit suffix + 1, via the
+            # float64 highest-set-bit trick (floor(log2)). f64 holds 53
+            # mantissa bits < the 64-p suffix width, so a value within
+            # half-ulp of a power of two can mis-rank by one register —
+            # an error far inside the sketch's own ~1/sqrt(r) noise.
+            wf = w.astype(jnp.float64)
+            hb = jnp.floor(jnp.log2(jnp.maximum(wf, 1.0)))
+            rho = jnp.where(w == jnp.uint64(0),
+                            jnp.float64(64 - p + 1),
+                            jnp.float64(64 - p) - hb)
+            # f32 ranks (<= 56: exact) route the register max through
+            # the measured selection table — Pallas one-pass on TPU
+            reg = K.seg_max(rho.astype(jnp.float32), idx, pipe.mask, r)
+            reg = jnp.maximum(X.pmax(reg), 0.0).astype(jnp.int64)
+            cols["__ndvreg"] = TV(padded(reg), None, T.INT64, None)
+            order.append("__ndvreg")
+
+        if self.key_stats:
+            mins, maxs, nulls = [], [], []
+            for tv in key_tvs[:self.key_stats]:
+                data = tv.data.astype(jnp.int64)
+                valid = pipe.mask if tv.validity is None \
+                    else pipe.mask & tv.validity
+                big = jnp.iinfo(jnp.int64).max
+                small = jnp.iinfo(jnp.int64).min
+                mins.append(X.pmin(jnp.min(
+                    jnp.where(valid, data, big))[None])[0])
+                maxs.append(X.pmax(jnp.max(
+                    jnp.where(valid, data, small))[None])[0])
+                nnull = jnp.zeros((), jnp.int64) if tv.validity is None \
+                    else (pipe.mask & ~tv.validity).sum(dtype=jnp.int64)
+                nulls.append(X.psum(nnull[None])[0])
+            cols["__kmin"] = TV(padded(jnp.stack(mins)), None, T.INT64,
+                                None)
+            cols["__kmax"] = TV(padded(jnp.stack(maxs)), None, T.INT64,
+                                None)
+            cols["__knull"] = TV(padded(jnp.stack(nulls)), None,
+                                 T.INT64, None)
+            order += ["__kmin", "__kmax", "__knull"]
+
         # replicated reductions: keep device 0's copy live, like
-        # PSumAggExec, so the d-row result reads back once
+        # PSumAggExec, so the result reads back once
         keep = X.axis_index() == 0
-        mask = jnp.broadcast_to(keep, (d,))
-        return Pipe({"__incoming": TV(incoming, None, T.INT64, None),
-                     "__maxslice": TV(maxslice, None, T.INT64, None)},
-                    mask, ["__incoming", "__maxslice"])
+        mask = jnp.broadcast_to(keep, (cap,))
+        return Pipe(cols, mask, order)
 
     def node_string(self):
         return f"ExchangeStats[{self.exchange.node_string()}]"
 
     def plan_key(self):
-        return ("ExchangeStats", self.exchange.plan_key())
+        return ("ExchangeStats", self.sketch_registers, self.key_stats,
+                self.exchange.plan_key())
 
 
 @dataclass(eq=False)
@@ -549,6 +638,10 @@ class DistSortAggExec(P.PhysicalPlan):
     groupings: Tuple[E.Expression, ...]
     aggregates: Tuple[E.Expression, ...]
     child: P.PhysicalPlan
+    #: adaptive-aggregation tag: "partial" marks the pre-exchange half
+    #: of a partial->final plan (the node the runtime strategy switch
+    #: may bypass or swap for a hash partial); None = ordinary
+    phase: Optional[str] = None
     traceable = True
 
     def children(self):
@@ -585,7 +678,93 @@ class DistSortAggExec(P.PhysicalPlan):
     def plan_key(self):
         return ("DistSortAgg", tuple(E.expr_key(g) for g in self.groupings),
                 tuple(E.expr_key(a) for a in self.aggregates),
-                self.child.plan_key())
+                self.phase, self.child.plan_key())
+
+
+@dataclass(eq=False)
+class DistHashPartialAggExec(P.PhysicalPlan):
+    """Hash-based partial aggregation over a RUNTIME-MEASURED key
+    domain: the stats stage measured each key's global [min, max] (and
+    nulls-present), so keys range-compress to collision-free packed
+    codes and the partials are dense segment reductions over
+    num_segments = the measured domain — no sort, no host sync, and
+    the reductions route through the measured selection table
+    (<= 64 XLA fused, 64 < K <= 1024 the Pallas one-pass kernel; see
+    ops/pallas_agg.py). This is the runtime analogue of the static
+    direct path in physical/operators.HashAggregateExec, unlocked for
+    int keys whose cardinality only the data knows.
+
+    Output schema/order contract: identical to the sort-based partial
+    (key aliases + partial accumulators), so the downstream exchange
+    and final merge are strategy-oblivious. Per-group values are
+    byte-identical to the sort partial for strategy-legal aggregates
+    (legality.strategy_verdict); only row order and capacity differ,
+    and the final merge re-groups anyway."""
+
+    groupings: Tuple[E.Expression, ...]
+    aggregates: Tuple[E.Expression, ...]
+    child: P.PhysicalPlan
+    key_mins: Tuple[int, ...] = ()    # measured per-key global min
+    key_ranges: Tuple[int, ...] = ()  # measured value range (max-min+1)
+    traceable = True
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def schema(self) -> Schema:
+        return P.HashAggregateExec(self.groupings, self.aggregates,
+                                   self.child).schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        cap = pipe.capacity
+        env = pipe.env()
+        key_tvs = [C.evaluate(g, env) for g in self.groupings]
+
+        codes, validities, cards = [], [], []
+        for tv, mn, rg in zip(key_tvs, self.key_mins, self.key_ranges):
+            # range compression: measured min/range make the clip a
+            # no-op for every live row (the measurement ran over these
+            # exact arrays); pack_codes adds the null slot per key
+            codes.append(jnp.clip(tv.data.astype(jnp.int64) - mn, 0,
+                                  rg - 1))
+            validities.append(tv.validity)
+            cards.append(int(rg))
+        seg, num_segments = K.pack_codes(codes, validities, cards)
+        seg = seg.astype(jnp.int32)
+        num_segments = max(1, int(num_segments))
+
+        _, agg_calls = rewrite_agg_outputs(self.groupings, self.aggregates)
+        agg_tvs = [P._compute_agg(a, env, seg, pipe.mask, num_segments,
+                                  cap)
+                   for a in agg_calls]
+
+        # LOCAL partials: each device keeps its own groups (no psum) —
+        # the downstream exchange routes them to the final merge
+        out_mask = K.seg_count(seg, pipe.mask, num_segments) > 0
+        nullable = [v is not None for v in validities]
+        unpacked = K.unpack_code(jnp.arange(num_segments), cards, nullable)
+        out_keys = []
+        for (code, valid), tv, mn in zip(unpacked, key_tvs,
+                                         self.key_mins):
+            data = (code + mn).astype(C._jnp_dtype(tv.dtype))
+            out_keys.append(TV(data, valid, tv.dtype, tv.dictionary))
+        agg_exec = P.HashAggregateExec(self.groupings, self.aggregates,
+                                       self.child)
+        return agg_exec._finalize(out_keys, agg_tvs, out_mask,
+                                  num_segments)
+
+    def node_string(self):
+        return (f"DistHashPartialAgg[keys="
+                f"[{', '.join(map(str, self.groupings))}], "
+                f"domain={tuple(self.key_ranges)}]")
+
+    def plan_key(self):
+        return ("DistHashPartialAgg",
+                tuple(E.expr_key(g) for g in self.groupings),
+                tuple(E.expr_key(a) for a in self.aggregates),
+                self.key_mins, self.key_ranges, self.child.plan_key())
 
 
 # ---- distributed join -------------------------------------------------------

@@ -256,6 +256,14 @@ def seg_min(data, seg, mask, num_segments: int, sorted_seg: bool = False):
         return jnp.min(masked)[None]
     if num_segments <= _MASKED_SEG_LIMIT:
         return _masked_reduce(data, seg, mask, num_segments, jnp.min, big)
+    if not sorted_seg:
+        # same measured selection table as seg_sum: 64 < K <= 1024 f32
+        # goes through the one-pass Pallas streaming reduction
+        from spark_tpu.ops import maybe_pallas_seg_min
+
+        out = maybe_pallas_seg_min(data, seg, mask, num_segments)
+        if out is not None:
+            return out
     if sorted_seg:
         return _sorted_seg_red(masked, seg, num_segments, jnp.minimum)
     return jax.ops.segment_min(masked, seg, num_segments=num_segments)
@@ -268,6 +276,12 @@ def seg_max(data, seg, mask, num_segments: int, sorted_seg: bool = False):
         return jnp.max(masked)[None]
     if num_segments <= _MASKED_SEG_LIMIT:
         return _masked_reduce(data, seg, mask, num_segments, jnp.max, small)
+    if not sorted_seg:
+        from spark_tpu.ops import maybe_pallas_seg_max
+
+        out = maybe_pallas_seg_max(data, seg, mask, num_segments)
+        if out is not None:
+            return out
     if sorted_seg:
         return _sorted_seg_red(masked, seg, num_segments, jnp.maximum)
     return jax.ops.segment_max(masked, seg, num_segments=num_segments)

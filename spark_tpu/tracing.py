@@ -515,6 +515,61 @@ def format_serve_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
+def aggregation_profile(events: Optional[List[dict]] = None
+                        ) -> Dict[str, dict]:
+    """Roll up adaptive-aggregation events (parallel/executor.py):
+    per-strategy pick counts from ``agg`` events, how each decision was
+    made (auto from the sketch / forced by conf / pinned by legality /
+    fallback after a sketch fault), the recent decisions with their
+    sketched NDV, live rows and NDV ratio, and the lifetime counters
+    (metrics.agg_stats)."""
+    evs = events if events is not None else metrics.recent(4096)
+    strategies: Dict[str, int] = {}
+    modes: Dict[str, int] = {}
+    recent: List[dict] = []
+    for e in evs:
+        if e.get("kind") != "agg":
+            continue
+        strat = str(e.get("strategy", "?"))
+        strategies[strat] = strategies.get(strat, 0) + 1
+        mode = str(e.get("mode", "?"))
+        modes[mode] = modes.get(mode, 0) + 1
+        recent.append({
+            "strategy": strat, "mode": mode,
+            "ndv": int(e.get("ndv", 0)), "rows": int(e.get("rows", 0)),
+            "ratio": round(float(e.get("ratio", 0.0)), 4),
+            "domain": int(e.get("domain", 0)),
+            "devices": int(e.get("devices", 0))})
+    return {"strategies": strategies, "modes": modes,
+            "recent": recent[-16:], "totals": metrics.agg_stats()}
+
+
+def format_aggregation_profile(
+        profile: Optional[Dict[str, dict]] = None) -> str:
+    p = profile if profile is not None else aggregation_profile()
+    t = p.get("totals", {})
+    if not p.get("strategies") and not any(t.values()):
+        return "(no adaptive aggregation events recorded)"
+    s = p.get("strategies", {})
+    m = p.get("modes", {})
+    lines = [
+        f"strategy picks: {s.get('partial', 0)} partial->final, "
+        f"{s.get('bypass', 0)} partial-bypass, "
+        f"{s.get('hash', 0)} hash-partial",
+        f"decisions: {m.get('auto', 0)} auto (sketch), "
+        f"{m.get('forced', 0)} conf-forced, "
+        f"{m.get('pinned', 0)} legality-pinned, "
+        f"{m.get('fallback', 0)} sketch-fault fallbacks "
+        f"({t.get('sketch_failures', 0)} lifetime)"]
+    if p.get("recent"):
+        lines.append("strategy  mode      ndv~      rows  ratio domain")
+        for r in p["recent"][-8:]:
+            lines.append(
+                f"{r['strategy']:<9} {r['mode']:<8} {r['ndv']:>6} "
+                f"{r['rows']:>9} {r['ratio']:>6.2f} {r['domain']:>6}")
+    return "\n".join(lines)
+
+
 def mview_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
     """Roll up materialized-view events (spark_tpu/mview/): refresh
     outcomes by how (incremental / full / fallback), retry + dedup

@@ -23,6 +23,9 @@ Endpoints:
   /api/v1/serve         federation tier: per-replica dispatch/shed/
                         re-dispatch rollup, result-cache hit/miss/
                         single-flight counters, serve.* gauges
+  /api/v1/agg           adaptive aggregation: per-strategy pick
+                        counts (partial->final / bypass / hash),
+                        sketch-vs-decision rollup, agg.* gauges
   /api/v1/mview         materialized views: refresh rollup
                         (incremental/full/fallback), per-view state,
                         stream merge/dedup counters, mview.* gauges
@@ -202,6 +205,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "counters": metrics.serve_stats(),
                 "gauges": {k: v for k, v in metrics.gauges().items()
                            if k.startswith("serve.")},
+            })
+        elif url.path == "/api/v1/agg":
+            from spark_tpu import tracing
+
+            self._json({
+                "profile": tracing.aggregation_profile(events),
+                "counters": metrics.agg_stats(),
+                "gauges": {k: v for k, v in metrics.gauges().items()
+                           if k.startswith("agg.")},
             })
         elif url.path == "/api/v1/mview":
             from spark_tpu import tracing

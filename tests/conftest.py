@@ -96,6 +96,11 @@ def pytest_configure(config):
         "mview: incrementally-maintained materialized views "
         "(spark_tpu/mview/) — delta detection, re-merge, stream "
         "convergence, serve repopulation")
+    config.addinivalue_line(
+        "markers",
+        "agg: runtime-adaptive aggregation — cardinality-sketched "
+        "strategy switching (partial->final / bypass / hash-partial), "
+        "Pallas segmented reductions, byte-identity sweeps")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -104,7 +109,7 @@ def pytest_collection_modifyitems(config, items):
     # hanging tier-1 (tests may still carry their own tighter timeout)
     for item in items:
         if ("compile" in item.keywords or "serve" in item.keywords
-                or "mview" in item.keywords) \
+                or "mview" in item.keywords or "agg" in item.keywords) \
                 and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):

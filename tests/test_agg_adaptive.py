@@ -1,0 +1,354 @@
+"""Runtime-adaptive aggregation (reference contrast: the reference
+always plans partial->final at compile time, AggUtils.scala; here the
+AQE stats stage carries a distinct-key sketch and the executor picks
+the strategy per aggregate AT RUNTIME).
+
+The hard invariant under test: every strategy the switch can pick —
+partial->final (the static plan), partial-bypass (raw rows exchanged
+straight to the final aggregate), hash-partial (measured packed-code
+domain) — produces BYTE-IDENTICAL results to the static plan, across
+device counts, key distributions, key types, forced and auto modes,
+and under injected sketch faults of every kind.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax.numpy as jnp
+
+import spark_tpu.expr.expressions as E
+import spark_tpu.plan.logical as L
+from spark_tpu import faults, metrics, tracing
+from spark_tpu.columnar.arrow import from_arrow
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.parallel.executor import MeshExecutor
+from spark_tpu.parallel.mesh import make_mesh
+
+pytestmark = pytest.mark.agg
+
+_MESHES = {}
+
+
+def _mesh(d):
+    if d not in _MESHES:
+        _MESHES[d] = make_mesh(d)
+    return _MESHES[d]
+
+
+def _executor(d, adaptive, **overrides):
+    conf = RuntimeConf({"spark.tpu.adaptive.enabled": bool(adaptive),
+                        **overrides})
+    return MeshExecutor(_mesh(d), conf=conf)
+
+
+def _rows(batch):
+    return [tuple(d.values()) for d in batch.to_pylist()]
+
+
+def _table(keys, vals):
+    return L.Relation(from_arrow(pa.table({
+        "k": pa.array(np.asarray(keys, np.int64), pa.int64()),
+        "v": pa.array(np.asarray(vals, np.int64), pa.int64()),
+    })))
+
+
+def _agg_plan(rel, value_col="v"):
+    """group-by with every strategy-legal accumulator class, sorted so
+    comparisons are order-free."""
+    v = E.Col(value_col)
+    return L.Sort((E.SortOrder(E.Col("k")),), L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Sum(v), "s"), E.Alias(E.Count(v), "n"),
+         E.Alias(E.Min(v), "mn"), E.Alias(E.Max(v), "mx")),
+        rel))
+
+
+def _dataset(dist, rng, n=3000):
+    if dist == "uniform":
+        keys = rng.integers(0, 50, n)          # low NDV, small domain
+    elif dist == "skewed":
+        keys = np.where(rng.random(n) < 0.9, 7,
+                        rng.integers(0, 5000, n))
+    else:  # all-distinct: NDV == rows, pre-aggregation is pure waste
+        keys = np.arange(n)
+    return _table(keys, rng.integers(0, 1000, n))
+
+
+def _agg_events():
+    return [e for e in metrics.recent(4096) if e.get("kind") == "agg"]
+
+
+# ---- the hard invariant: byte-identity across the whole sweep ---------------
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+@pytest.mark.parametrize("dist", ["uniform", "skewed", "distinct"])
+@pytest.mark.timeout(300)
+def test_byte_identity_strategy_sweep(devices, dist, rng):
+    plan = _agg_plan(_dataset(dist, rng))
+    off = _rows(_executor(devices, False).execute_logical(plan))
+    for strategy in ("auto", "partial", "bypass", "hash"):
+        on = _rows(_executor(
+            devices, True,
+            **{"spark.tpu.adaptive.agg.strategy": strategy},
+        ).execute_logical(plan))
+        assert on == off, (devices, dist, strategy)
+
+
+@pytest.mark.timeout(300)
+def test_byte_identity_string_keys(rng):
+    n = 2000
+    words = [f"key-{i}" for i in range(40)]
+    keys = [words[i] for i in rng.integers(0, len(words), n)]
+    rel = L.Relation(from_arrow(pa.table({
+        "k": pa.array(keys, pa.string()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })))
+    plan = _agg_plan(rel)
+    off = _rows(_executor(2, False).execute_logical(plan))
+    for strategy in ("auto", "partial", "bypass", "hash"):
+        on = _rows(_executor(
+            2, True, **{"spark.tpu.adaptive.agg.strategy": strategy},
+        ).execute_logical(plan))
+        assert on == off, strategy
+
+
+# ---- auto mode picks the right strategy -------------------------------------
+
+
+@pytest.mark.timeout(300)
+def test_auto_picks_bypass_on_all_distinct(rng):
+    metrics.reset_agg()
+    plan = _agg_plan(_dataset("distinct", rng))
+    _executor(2, True).execute_logical(plan)
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "bypass" and ev["mode"] == "auto"
+    assert ev["ratio"] >= 0.5
+    assert metrics.agg_stats()["bypass"] == 1
+
+
+@pytest.mark.timeout(300)
+def test_auto_picks_hash_on_small_domain(rng):
+    metrics.reset_agg()
+    plan = _agg_plan(_dataset("uniform", rng))
+    got = _rows(_executor(2, True).execute_logical(plan))
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "hash" and ev["mode"] == "auto"
+    assert 0 < ev["domain"] <= 1024
+    assert len(got) == len({r[0] for r in got})
+
+
+@pytest.mark.timeout(300)
+def test_auto_falls_back_to_partial_on_wide_domain(rng):
+    # mid ratio + domain beyond the limit: neither bypass nor hash wins
+    n = 3000
+    keys = rng.integers(0, 1 << 30, n) * 2     # huge sparse domain
+    keys[n // 2:] = keys[: n - n // 2]         # ~50% duplication
+    metrics.reset_agg()
+    plan = _agg_plan(_table(keys, rng.integers(0, 1000, n)))
+    _executor(2, True,
+              **{"spark.tpu.adaptive.agg.bypassNdvRatio": 0.9},
+              ).execute_logical(plan)
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["mode"] == "auto"
+
+
+@pytest.mark.timeout(300)
+def test_float_sum_pins_to_partial(rng):
+    # float Sum partials are order-dependent: the switch must pin to
+    # the static plan even when the conf FORCES another strategy
+    n = 2000
+    rel = L.Relation(from_arrow(pa.table({
+        "k": pa.array(np.arange(n), pa.int64()),
+        "f": pa.array(rng.random(n), pa.float64()),
+    })))
+    plan = L.Sort((E.SortOrder(E.Col("k")),), L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Sum(E.Col("f")), "fs")), rel))
+    off = _rows(_executor(2, False).execute_logical(plan))
+    metrics.reset_agg()
+    on = _rows(_executor(
+        2, True, **{"spark.tpu.adaptive.agg.strategy": "bypass"},
+    ).execute_logical(plan))
+    assert on == off
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["mode"] == "pinned"
+    assert metrics.agg_stats()["pinned"] == 1
+
+
+@pytest.mark.timeout(300)
+def test_forced_hash_falls_back_without_key_stats(rng):
+    # float group keys cannot range-compress: forced hash degrades to
+    # partial instead of failing the query
+    n = 1000
+    rel = L.Relation(from_arrow(pa.table({
+        "k": pa.array(rng.integers(0, 20, n).astype(np.float64),
+                      pa.float64()),
+        "v": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })))
+    plan = L.Sort((E.SortOrder(E.Col("k")),), L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Count(E.Col("v")), "n")), rel))
+    off = _rows(_executor(2, False).execute_logical(plan))
+    metrics.reset_agg()
+    on = _rows(_executor(
+        2, True, **{"spark.tpu.adaptive.agg.strategy": "hash"},
+    ).execute_logical(plan))
+    assert on == off
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["mode"] == "forced"
+
+
+# ---- sketch accuracy --------------------------------------------------------
+
+
+@pytest.mark.parametrize("true_ndv", [10, 100, 1000, 5000])
+@pytest.mark.timeout(300)
+def test_hll_estimate_accuracy(true_ndv):
+    """Host-side oracle over the same register construction the stats
+    stage traces: m=512 registers give ~1.04/sqrt(m) = 4.6% standard
+    error; linear counting covers the small range. Bound at 4 sigma."""
+    rng = np.random.default_rng(true_ndv)
+    m, p = 512, 9
+    # full-width 64-bit hashes (two 32-bit draws: integers() cannot
+    # express high=2**64) — a short top bit would bias every rank +1
+    h = ((rng.integers(0, 1 << 32, true_ndv, dtype=np.uint64)
+          << np.uint64(32))
+         | rng.integers(0, 1 << 32, true_ndv, dtype=np.uint64))
+    idx = (h & np.uint64(m - 1)).astype(np.int64)
+    w = h >> np.uint64(p)
+    nbits = 64 - p
+    rho = np.where(w == 0, nbits + 1,
+                   nbits - np.floor(np.log2(np.maximum(
+                       w.astype(np.float64), 1.0))))
+    regs = np.zeros(m, dtype=np.int64)
+    np.maximum.at(regs, idx, rho.astype(np.int64))
+    est = MeshExecutor._hll_estimate(regs)
+    assert abs(est - true_ndv) <= max(4, 4 * 1.04 / np.sqrt(m) * true_ndv)
+
+
+@pytest.mark.timeout(300)
+def test_sketch_ndv_end_to_end(rng):
+    # the measured event's NDV estimate lands within the sketch's noise
+    n, true_ndv = 4000, 200
+    metrics.reset_agg()
+    plan = _agg_plan(_table(rng.integers(0, true_ndv, n),
+                            rng.integers(0, 1000, n)))
+    _executor(2, True,
+              **{"spark.tpu.adaptive.agg.hashDomainLimit": 16},
+              ).execute_logical(plan)
+    ev = _agg_events()[-1]
+    assert ev["rows"] == n
+    assert abs(ev["ndv"] - true_ndv) <= 0.25 * true_ndv
+
+
+# ---- Pallas kernels vs numpy oracles (interpret mode) -----------------------
+
+
+def _oracle_reduce(data, seg, mask, k, red, init):
+    out = np.full(k, init, dtype=np.float64)
+    for s, d, m in zip(seg, data, mask):
+        if m and 0 <= s < k:
+            out[s] = red(out[s], d)
+    return out
+
+
+@pytest.mark.parametrize("k", [65, 257, 1024])
+@pytest.mark.timeout(300)
+def test_pallas_minmax_interpret_oracle(k, rng):
+    from spark_tpu.ops import pallas_seg_minmax
+
+    n = 5000
+    data = rng.standard_normal(n).astype(np.float32) * 100
+    seg = rng.integers(0, k, n).astype(np.int32)
+    mask = rng.random(n) < 0.8
+    seg[seg == k // 2] = k - 1  # leave group k//2 empty
+    got_min = np.asarray(pallas_seg_minmax(
+        jnp.asarray(data), jnp.asarray(seg), jnp.asarray(mask), k,
+        is_max=False, interpret=True))
+    got_max = np.asarray(pallas_seg_minmax(
+        jnp.asarray(data), jnp.asarray(seg), jnp.asarray(mask), k,
+        is_max=True, interpret=True))
+    want_min = _oracle_reduce(data, seg, mask, k, min, np.inf)
+    want_max = _oracle_reduce(data, seg, mask, k, max, -np.inf)
+    np.testing.assert_array_equal(got_min, want_min.astype(np.float32))
+    np.testing.assert_array_equal(got_max, want_max.astype(np.float32))
+    assert got_min[k // 2] == np.inf and got_max[k // 2] == -np.inf
+
+
+@pytest.mark.timeout(300)
+def test_pallas_sum_count_mean_interpret_oracle(rng):
+    from spark_tpu.ops import pallas_seg_sum
+
+    n, k = 5000, 300
+    data = rng.integers(0, 100, n).astype(np.float32)
+    seg = rng.integers(0, k, n).astype(np.int32)
+    mask = rng.random(n) < 0.7
+    jd, js, jm = jnp.asarray(data), jnp.asarray(seg), jnp.asarray(mask)
+    got_sum = np.asarray(pallas_seg_sum(jd, js, jm, k, interpret=True))
+    got_cnt = np.asarray(pallas_seg_sum(
+        jm.astype(jnp.float32), js, jm, k, interpret=True,
+        exact_int=True))
+    want_sum = np.zeros(k)
+    want_cnt = np.zeros(k, dtype=np.int64)
+    for s, d, m in zip(seg, data, mask):
+        if m:
+            want_sum[s] += d
+            want_cnt[s] += 1
+    np.testing.assert_array_equal(got_sum, want_sum.astype(np.float32))
+    np.testing.assert_array_equal(got_cnt, want_cnt)
+    # mean = sum/count with empty groups NaN, the maybe_ contract
+    mean = np.where(got_cnt > 0, got_sum / np.maximum(got_cnt, 1),
+                    np.nan)
+    want_mean = np.where(want_cnt > 0,
+                         want_sum / np.maximum(want_cnt, 1), np.nan)
+    np.testing.assert_allclose(mean, want_mean, rtol=1e-6)
+
+
+# ---- fault injection: the sketch is advisory --------------------------------
+
+
+@pytest.mark.parametrize("kind", list(faults.KINDS))
+@pytest.mark.timeout(300)
+def test_sketch_fault_falls_back_to_static(kind, rng):
+    """ANY injected fault at agg.strategy — even 'corrupt', because the
+    estimate is discarded and never merged into results — degrades to
+    the static partial->final plan with identical bytes."""
+    plan = _agg_plan(_dataset("distinct", rng))
+    off = _rows(_executor(2, False).execute_logical(plan))
+    metrics.reset_agg()
+    ex = _executor(2, True, **{
+        "spark.tpu.faultInjection.agg.strategy": f"nth:1:{kind}"})
+    on = _rows(ex.execute_logical(plan))
+    assert on == off
+    assert faults.fire_count(ex.conf, "agg.strategy") == 1
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["mode"] == "fallback"
+    assert metrics.agg_stats()["sketch_failures"] == 1
+
+
+# ---- observability ----------------------------------------------------------
+
+
+@pytest.mark.timeout(300)
+def test_aggregation_profile_rolls_up(rng):
+    metrics.reset_agg()
+    _executor(2, True).execute_logical(_agg_plan(_dataset("distinct",
+                                                          rng)))
+    prof = tracing.aggregation_profile()
+    assert prof["strategies"].get("bypass", 0) >= 1
+    assert prof["recent"] and prof["recent"][-1]["strategy"] == "bypass"
+    text = tracing.format_aggregation_profile(prof)
+    assert "bypass" in text
+
+
+@pytest.mark.timeout(300)
+def test_empty_input_defaults_to_partial(rng):
+    plan = _agg_plan(_table(np.array([], np.int64),
+                            np.array([], np.int64)))
+    metrics.reset_agg()
+    got = _rows(_executor(2, True).execute_logical(plan))
+    assert got == []
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["rows"] == 0

@@ -353,8 +353,12 @@ def test_all_adaptive_conf_keys_declared():
     for path in glob.glob(os.path.join(root, "**", "*.py"),
                           recursive=True):
         with open(path) as f:
+            # maximal dotted match so nested namespaces
+            # (spark.tpu.adaptive.agg.enabled) resolve to the full key,
+            # not the unregistered spark.tpu.adaptive.agg prefix
             used.update(re.findall(
-                r"spark\.tpu\.(?:adaptive|kernels)\.\w+", f.read()))
+                r"spark\.tpu\.(?:adaptive|kernels)\.\w+(?:\.\w+)*",
+                f.read()))
     assert used, "no adaptive/kernels conf keys found in source"
     for key in used:
         assert key in CF._REGISTRY, f"{key} not registered in conf.py"
