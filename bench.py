@@ -145,6 +145,12 @@ MVIEW_MODE = os.environ.get("BENCH_MVIEW", "1") == "1"
 # the result JSON; needs BENCH_MASTER=mesh[N] to engage)
 AGG_MODE = os.environ.get("BENCH_AGG", "1") == "1"
 
+# BENCH_TRACE=0 skips the tracing-overhead A/B (q1/q3 timed with the
+# span layer off vs always-on vs 10%-sampled; overhead % + byte-identity
+# + the host/device/queue/transfer breakdown of one traced q3 land
+# under 'trace' in the result JSON)
+TRACE_MODE = os.environ.get("BENCH_TRACE", "1") == "1"
+
 
 def _warmup_child() -> None:
     """Subprocess entry for the cold-start A/B (BENCH_WARMUP_CHILD=1):
@@ -902,6 +908,27 @@ def main():
                    "agg": agg_ab,
                    "robustness": _robustness_counters()})
 
+    trace_ab = None
+    if TRACE_MODE:
+        if _wall_remaining() <= 5:
+            trace_ab = {"error": "skipped: wall budget exhausted",
+                        "phase": "trace"}
+        else:
+            print("[bench] trace A/B: q1/q3 span layer off vs on vs "
+                  "sampled, + host/device/queue breakdown of one q3",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_query_deadline()):
+                    trace_ab = _run_trace_ab(spark)
+            except _QueryTimeout:
+                trace_ab = {"error": "timeout"}
+            except Exception as e:
+                trace_ab = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "trace": trace_ab,
+                   "robustness": _robustness_counters()})
+
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
     # headline number stays meaningful (flagged via queries_failed)
@@ -938,6 +965,7 @@ def main():
         **({"serve": serve_ab} if serve_ab is not None else {}),
         **({"mview": mview} if mview is not None else {}),
         **({"agg": agg_ab} if agg_ab is not None else {}),
+        **({"trace": trace_ab} if trace_ab is not None else {}),
         **({"analysis": analysis_overhead}
            if analysis_overhead is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}
@@ -1127,6 +1155,69 @@ def _run_agg_ab(spark) -> dict:
     finally:
         conf.unset("spark.tpu.adaptive.agg.enabled")
         conf.unset("spark.tpu.adaptive.enabled")
+    return out
+
+
+def _run_trace_ab(spark) -> dict:
+    """Tracing-overhead A/B: q1 and q3 timed (median of 3 warm runs)
+    with the span layer off (spark.tpu.trace.enabled=false), always-on
+    (the default), and 10%-sampled. The headline number is
+    overhead_pct — always-on tracing must stay in the low single
+    digits on a warm q1 — and every arm's Arrow output must be
+    byte-identical to the untraced run. One fully-traced q3 run is
+    then decomposed via tracing.trace_breakdown() into
+    host/device/queue/transfer ms so the JSON shows where the wall
+    time of a real query actually goes."""
+    from spark_tpu import metrics, tracing
+    from spark_tpu.tpch.queries import QUERIES
+
+    conf = spark.conf
+    out = {}
+    try:
+        for q in (1, 3):
+            df = spark.sql(QUERIES[q])
+
+            def timed(enabled, ratio):
+                conf.set("spark.tpu.trace.enabled", enabled)
+                conf.set("spark.tpu.trace.sampleRatio", ratio)
+                df.toArrow()  # warm-up: compile off the clock
+                got, runs = None, []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    got = df.toArrow()
+                    runs.append((time.perf_counter() - t0) * 1000.0)
+                return got, round(sorted(runs)[1], 1)
+
+            off_tbl, off_ms = timed(False, 1.0)
+            on_tbl, on_ms = timed(True, 1.0)
+            samp_tbl, samp_ms = timed(True, 0.1)
+            out[f"q{q}"] = {
+                "off_ms": off_ms,
+                "on_ms": on_ms,
+                "sampled_ms": samp_ms,
+                "overhead_pct": (round((on_ms - off_ms) / off_ms * 100, 2)
+                                 if off_ms else None),
+                "sampled_overhead_pct": (
+                    round((samp_ms - off_ms) / off_ms * 100, 2)
+                    if off_ms else None),
+                "byte_identical": bool(on_tbl.equals(off_tbl)
+                                       and samp_tbl.equals(off_tbl)),
+            }
+        # one fully-traced q3: where did the wall time go?
+        conf.set("spark.tpu.trace.enabled", True)
+        conf.set("spark.tpu.trace.sampleRatio", 1.0)
+        spark.sql(QUERIES[3]).toArrow()
+        evs = metrics.last_query()
+        bd = tracing.trace_breakdown(evs)
+        out["q3_breakdown"] = {
+            **{k: round(v, 1) for k, v in bd.items()},
+            "spans": sum(1 for e in evs if e.get("kind") == "span"),
+            "trace_id": next((e.get("trace_id") for e in evs
+                              if e.get("trace_id")), None),
+        }
+    finally:
+        conf.unset("spark.tpu.trace.enabled")
+        conf.unset("spark.tpu.trace.sampleRatio")
     return out
 
 

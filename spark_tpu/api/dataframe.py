@@ -289,7 +289,16 @@ class DataFrame:
     # ---- actions -----------------------------------------------------------
 
     def _execute(self):
-        from spark_tpu import metrics
+        from spark_tpu import trace
+
+        # root span when standalone; child when a connect server /
+        # scheduler ticket already carries a trace for this query
+        with trace.span("query.execute",
+                        plan=type(self._plan).__name__):
+            return self._execute_traced()
+
+    def _execute_traced(self):
+        from spark_tpu import metrics, trace
 
         if self._session is not None:
             self._session._ensure_active()
@@ -298,7 +307,8 @@ class DataFrame:
             # the plan carries error-level diagnostics
             from spark_tpu.analysis import maybe_gate
 
-            maybe_gate(self._plan, self._session.conf)
+            with trace.span("query.analysis"):
+                maybe_gate(self._plan, self._session.conf)
         metrics.query_start(self._plan.node_string())
         ex = getattr(self._session, "mesh_executor", None) \
             if self._session is not None else None
@@ -351,8 +361,10 @@ class DataFrame:
             # pin_scope: every MemoryStore entry this query reads
             # (cached plans, auto-cached scans) is held against
             # eviction until the query finishes
-            with pin_scope():
-                plan = self._session.cache_manager.apply(plan, run_full)
+            with trace.span("storage.pin"), pin_scope():
+                with trace.span("mview.probe"):
+                    plan = self._session.cache_manager.apply(
+                        plan, run_full)
                 # lineage recompute on transient environment failure
                 # (reference: DAGScheduler.scala:1762 stage resubmission)
                 out = run_stage_with_recovery(

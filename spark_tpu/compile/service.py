@@ -93,9 +93,12 @@ def build_stage_callable(tier: str, plan, trace_fn: Callable, example_args,
     if svc is None or svc.store is None:
         return jitted
     try:
-        return svc.stage_callable(tier, plan, jitted, example_args,
-                                  schema_box, mesh_size=mesh_size,
-                                  platform=platform, extra=extra)
+        from spark_tpu import trace
+
+        with trace.span("compile.probe", tier=tier):
+            return svc.stage_callable(tier, plan, jitted, example_args,
+                                      schema_box, mesh_size=mesh_size,
+                                      platform=platform, extra=extra)
     except Exception as e:
         metrics.record("compile", phase="stage_callable_error",
                        error=repr(e))

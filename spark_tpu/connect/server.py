@@ -13,7 +13,7 @@ from typing import Optional
 
 import pyarrow as pa
 
-from spark_tpu import faults
+from spark_tpu import faults, trace
 
 
 class ConnectServer:
@@ -60,6 +60,11 @@ class ConnectServer:
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
+                    tid = trace.current_trace_id()
+                    if tid:
+                        # echo the trace id so clients can fetch
+                        # GET /trace/<id> for the waterfall
+                        self.send_header("X-SparkTpu-Trace-Id", tid)
                     for k, v in (headers or {}).items():
                         self.send_header(k, v)
                     self.end_headers()
@@ -94,6 +99,25 @@ class ConnectServer:
                         {"status": outer.scheduler.status(),
                          "queries": outer.scheduler.describe()}).encode()
                     self._send(200, body, "application/json")
+                elif self.path.startswith("/trace/"):
+                    # Chrome trace-event JSON for one trace id, ready
+                    # for Perfetto / chrome://tracing (in-process
+                    # replicas share the metrics ring, so any replica
+                    # can render the whole fleet-crossing trace)
+                    from spark_tpu import history, metrics
+
+                    tid = self.path.rsplit("/", 1)[1]
+                    evs = metrics.query_events(tid)
+                    if not evs:
+                        self._send(
+                            404,
+                            json.dumps({"error": "unknown trace",
+                                        "trace_id": tid}).encode(),
+                            "application/json")
+                    else:
+                        body = json.dumps(
+                            history.chrome_trace(evs)).encode()
+                        self._send(200, body, "application/json")
                 else:
                     self._send(404, b"not found", "text/plain")
 
@@ -135,6 +159,18 @@ class ConnectServer:
                     self._send(404, b"not found", "text/plain")
                     return
                 n = int(self.headers.get("Content-Length", "0"))
+                # adopt the caller's trace (client or federation
+                # router) so this request's spans — scheduler, stages,
+                # faults — join the fleet-wide trace; a missing/bad
+                # header starts a fresh root here
+                rctx = trace.from_header(
+                    self.headers.get(trace.TRACE_HEADER))
+                with trace.attach(rctx), \
+                        trace.span("connect.request", path=self.path,
+                                   replica=outer.replica_id):
+                    self._handle_query(n)
+
+            def _handle_query(self, n: int) -> None:
                 try:
                     faults.inject("connect.request", outer.session.conf)
                     req = json.loads(self.rfile.read(n))
@@ -187,8 +223,9 @@ class ConnectServer:
                             t = holder["ticket"] = submit(lambda: df)
                             return t.result()
 
-                        blob, status = cache.get_or_execute(
-                            key, execute)
+                        with trace.span("result_cache.probe"):
+                            blob, status = cache.get_or_execute(
+                                key, execute)
                         headers = {
                             "X-SparkTpu-Replica": outer.replica_id,
                             "X-Cache": status}
@@ -325,6 +362,10 @@ class Client:
         #: replica affinity echoed by a federation router; None until
         #: the first routed response
         self.affinity: Optional[str] = None
+        #: trace id of the last completed request (the server echoes
+        #: it via X-SparkTpu-Trace-Id); fetch the waterfall with
+        #: ``trace(client.last_trace_id)``
+        self.last_trace_id: Optional[str] = None
 
     def _jitter(self, attempt: int) -> float:
         import random as _random
@@ -335,6 +376,14 @@ class Client:
 
     def _post(self, path: str, payload: dict,
               pool: Optional[str] = None) -> pa.Table:
+        # one client-side span across every retry attempt: the whole
+        # request (including backoff) is a single unit of the trace,
+        # and each attempt ships the span context in X-SparkTpu-Trace
+        with trace.span("connect.client", path=path):
+            return self._post_retrying(path, payload, pool)
+
+    def _post_retrying(self, path: str, payload: dict,
+                       pool: Optional[str] = None) -> pa.Table:
         import time as _time
 
         last: Optional[BaseException] = None
@@ -369,6 +418,9 @@ class Client:
             headers["X-Spark-Pool"] = pool
         if self.affinity:
             headers["X-SparkTpu-Replica"] = self.affinity
+        hv = trace.header_value()
+        if hv:
+            headers[trace.TRACE_HEADER] = hv
         req = urllib.request.Request(
             self.url + path,
             data=json.dumps(payload).encode(), headers=headers)
@@ -379,6 +431,9 @@ class Client:
                 rid = resp.headers.get("X-SparkTpu-Replica")
                 if rid:
                     self.affinity = rid
+                tid = resp.headers.get("X-SparkTpu-Trace-Id")
+                if tid:
+                    self.last_trace_id = tid
         except urllib.error.HTTPError as e:
             detail = json.loads(e.read())
             if e.code == 429:
@@ -451,6 +506,19 @@ class Client:
         import urllib.request
 
         with urllib.request.urlopen(self.url + "/health",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def trace(self, trace_id: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (Perfetto-loadable) for a trace id
+        (default: the last completed request's)."""
+        import urllib.request
+
+        tid = trace_id or self.last_trace_id
+        if not tid:
+            raise ValueError("no trace id: run a query first or pass "
+                             "trace_id explicitly")
+        with urllib.request.urlopen(f"{self.url}/trace/{tid}",
                                     timeout=self.timeout) as resp:
             return json.loads(resp.read())
 

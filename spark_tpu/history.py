@@ -9,9 +9,18 @@ history legible — this module folds the JSONL event stream
 per-stage rollups and renders them as text (CLI) or a single static
 HTML file.
 
+Span events (spark_tpu/trace/) ride the same stream: ``chrome_trace``
+folds one query's span tree into Chrome trace-event JSON — load the
+file in Perfetto (ui.perfetto.dev) or chrome://tracing for the
+waterfall the reference gets from its timeline view. The live server
+serves it at ``GET /trace/<trace_id>``; offline, ``--perfetto out.json
+[--trace <id>]`` renders it from a JSONL log.
+
 Usage::
 
     python -m spark_tpu.history <event-log-dir-or-file> [--html out.html]
+    python -m spark_tpu.history <event-log-dir> --perfetto out.json \
+        [--trace <trace_id>]
 
 or programmatically: ``history.summarize(path)`` -> list of query
 dicts; ``spark_tpu.tracing.query_profile()`` remains the live
@@ -69,7 +78,13 @@ def summarize_events(events) -> List[Dict[str, Any]]:
             close()
             current = {"label": str(ev.get("description", "?")),
                        "ts": ev.get("ts"), "stages": [],
+                       "trace_id": ev.get("trace_id"),
                        "events": 0, "total_ms": 0.0}
+            continue
+        if kind == "span":
+            # spans nest (query.execute contains every stage), so their
+            # ms would double-count into total_ms; the trace view
+            # (chrome_trace / tracing.format_trace) is their rollup
             continue
         if current is None:
             current = {"label": "(before first query mark)", "ts": None,
@@ -97,6 +112,72 @@ def summarize_events(events) -> List[Dict[str, Any]]:
             })
     close()
     return queries
+
+
+def chrome_trace(events, trace_id: Optional[str] = None
+                 ) -> Dict[str, Any]:
+    """Fold span events into Chrome trace-event JSON (the format
+    Perfetto and chrome://tracing load). ``events`` is any event
+    iterable (``metrics.query_events(tid)``, a JSONL log); when
+    ``trace_id`` is given only that trace is rendered.
+
+    Mapping: each ``span`` event becomes one complete ("X") slice —
+    ``ts``/``dur`` in microseconds relative to the trace's earliest
+    span, ``pid`` per replica (the ``replica`` attr; 0 = driver/client
+    side), ``tid`` from the recording thread — so the fleet renders as
+    one process lane per replica with real thread interleaving. Flat
+    traced events (fault_injected, serve shed/redispatch, stage_retry)
+    become instant ("i") markers on the same lanes."""
+    evs = [e for e in events
+           if trace_id is None or e.get("trace_id") == trace_id]
+    spans = [e for e in evs if e.get("kind") == "span"
+             and "t0" in e and "ms" in e]
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(float(e["t0"]) for e in spans)
+    # one Chrome "process" lane per replica; 0 is the driver/client side
+    pids: Dict[str, int] = {}
+
+    def pid_of(ev: Dict[str, Any]) -> int:
+        rep = ev.get("replica")
+        if rep is None:
+            return 0
+        return pids.setdefault(str(rep), len(pids) + 1)
+
+    meta_keys = ("kind", "name", "ms", "t0", "ts", "tid", "n")
+    out: List[Dict[str, Any]] = []
+    for e in spans:
+        out.append({
+            "name": str(e.get("name", "span")),
+            "cat": "span",
+            "ph": "X",
+            "ts": round((float(e["t0"]) - base) * 1e6, 1),
+            "dur": round(float(e["ms"]) * 1e3, 1),
+            "pid": pid_of(e),
+            "tid": int(e.get("tid", 0)),
+            "args": {k: v for k, v in e.items() if k not in meta_keys},
+        })
+    marker_kinds = ("fault_injected", "fault_recovered", "stage_retry",
+                    "chunk_retry", "serve", "result_cache")
+    for e in evs:
+        if e.get("kind") in marker_kinds and "ts" in e:
+            out.append({
+                "name": str(e.get("kind")),
+                "cat": "event",
+                "ph": "i",
+                "s": "t",
+                "ts": round(max(0.0, (float(e["ts"]) - base)) * 1e6, 1),
+                "pid": pid_of(e),
+                "tid": int(e.get("tid", 0)),
+                "args": {k: v for k, v in e.items()
+                         if k not in meta_keys},
+            })
+    meta = [{"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "driver"}}]
+    meta += [{"name": "process_name", "ph": "M", "pid": p,
+              "args": {"name": f"replica {r}"}}
+             for r, p in sorted(pids.items(), key=lambda kv: kv[1])]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 
 
 def render_text(queries: List[Dict[str, Any]], top: int = 8) -> str:
@@ -151,7 +232,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("path", help="event-log file or directory")
     ap.add_argument("--html", metavar="OUT",
                     help="write a static HTML report instead of text")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="write Chrome trace-event JSON (load in "
+                         "ui.perfetto.dev) instead of text")
+    ap.add_argument("--trace", metavar="TRACE_ID",
+                    help="with --perfetto: render only this trace id")
     args = ap.parse_args(argv)
+    if args.perfetto:
+        doc = chrome_trace(_iter_events(args.path), trace_id=args.trace)
+        n = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+        if not n:
+            print("no span events found")
+            return 1
+        with open(args.perfetto, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {args.perfetto} ({n} spans)")
+        return 0
     queries = summarize(args.path)
     if not queries:
         print("no events found")

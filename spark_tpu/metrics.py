@@ -9,25 +9,68 @@ wall time. The in-memory ring is inspectable via ``recent()``/
 ``last_query()``; setting ``spark.eventLog.dir`` also appends JSONL to
 disk so hung or slow stages are visible post-mortem (the round-2 q19/q21
 hangs shipped precisely because nothing recorded per-stage timing).
+
+Trace attribution: ``record()`` stamps the active span context
+(spark_tpu/trace/ keeps it in the contextvar held here) onto every
+event as ``trace_id``/``span_id``/``parent_id``, and query marks are
+trace-id keyed — ``last_query()`` selects by trace id when the newest
+query has one, so concurrent queries no longer steal each other's
+stage/fault events; positional slicing survives only as the fallback
+for id-less events.
+
+Disk writes are buffered: ``record()`` appends to an in-memory line
+buffer flushed on size (``_LOG_FLUSH_EVENTS``) or age
+(``_LOG_FLUSH_SECONDS``), plus ``flush_log()`` at query end (trace root
+exit) and atexit — span-volume logging must not serialize hot stages
+behind one open+write per event.
 """
 
 from __future__ import annotations
 
+import atexit
+import contextvars
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _LOCK = threading.Lock()
 _IO_LOCK = threading.Lock()
 _EVENTS: deque = deque(maxlen=4096)
+#: (first event counter, trace_id-or-None) per started query
 _QUERY_MARKS: deque = deque(maxlen=64)
 _counter = 0
 
+#: active span context — a spark_tpu.trace.SpanContext; lives here (not
+#: in spark_tpu/trace/) so record() can read it without an import cycle
+_TRACE_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "spark_tpu_trace_ctx", default=None)
+
+
+def trace_context():
+    return _TRACE_CTX.get()
+
+
+def set_trace_context(ctx):
+    """Set the active span context; returns the token for reset."""
+    return _TRACE_CTX.set(ctx)
+
+
+def reset_trace_context(token) -> None:
+    _TRACE_CTX.reset(token)
+
 
 _PATH_CACHE: Dict[str, Optional[str]] = {}
+
+# ---- buffered JSONL writer (all state under _IO_LOCK) ----------------------
+
+_LOG_BUF: List[str] = []
+_LOG_BUF_PATH: Optional[str] = None
+_LOG_LAST_FLUSH = 0.0
+_LOG_FLUSH_EVENTS = 128
+_LOG_FLUSH_SECONDS = 0.5
 
 
 def _log_path() -> Optional[str]:
@@ -53,27 +96,74 @@ def _log_path() -> Optional[str]:
 
 def record(kind: str, **fields: Any) -> None:
     global _counter
-    ev = {"n": _counter, "ts": round(time.time(), 4), "kind": kind}
+    ev = {"ts": round(time.time(), 4), "kind": kind}
     ev.update(fields)
+    ctx = _TRACE_CTX.get()
+    if ctx is not None:
+        # stamp the enclosing span's identity; explicit fields (the
+        # span event records its own triple) win
+        ev.setdefault("trace_id", ctx[0])
+        ev.setdefault("span_id", ctx[1])
+        if ctx[2] is not None:
+            ev.setdefault("parent_id", ctx[2])
     path = _log_path()
     with _LOCK:
+        ev["n"] = _counter
         _counter += 1
         _EVENTS.append(ev)
     if path is not None:
-        # separate IO lock: disk latency must not serialize stages that
-        # only touch the in-memory ring
-        with _IO_LOCK:
+        _buffered_write(path, json.dumps(ev) + "\n")
+
+
+def _buffered_write(path: str, line: str) -> None:
+    """Append one JSONL line through the buffer. Separate IO lock: disk
+    latency must not serialize stages that only touch the in-memory
+    ring; appends buffer and flush on size/age so span volume costs one
+    write per batch, not per event."""
+    global _LOG_BUF_PATH, _LOG_LAST_FLUSH
+    now = time.monotonic()
+    with _IO_LOCK:
+        if _LOG_BUF_PATH != path:
+            # eventLog.dir changed mid-run: drain to the old file
+            if _LOG_BUF and _LOG_BUF_PATH is not None:
+                with open(_LOG_BUF_PATH, "a") as f:
+                    f.write("".join(_LOG_BUF))
+            _LOG_BUF.clear()
+            _LOG_BUF_PATH = path
+            _LOG_LAST_FLUSH = now
+        _LOG_BUF.append(line)
+        if (len(_LOG_BUF) >= _LOG_FLUSH_EVENTS
+                or now - _LOG_LAST_FLUSH >= _LOG_FLUSH_SECONDS):
             with open(path, "a") as f:
-                f.write(json.dumps(ev) + "\n")
+                f.write("".join(_LOG_BUF))
+            _LOG_BUF.clear()
+            _LOG_LAST_FLUSH = now
+
+
+def flush_log() -> None:
+    """Drain the buffered JSONL writer (query end / atexit / before a
+    reader opens the file)."""
+    global _LOG_LAST_FLUSH
+    with _IO_LOCK:
+        if _LOG_BUF and _LOG_BUF_PATH is not None:
+            with open(_LOG_BUF_PATH, "a") as f:
+                f.write("".join(_LOG_BUF))
+        _LOG_BUF.clear()
+        _LOG_LAST_FLUSH = time.monotonic()
+
+
+atexit.register(flush_log)
 
 
 def query_start(description: str) -> int:
+    ctx = _TRACE_CTX.get()
+    tid = ctx[0] if ctx is not None else None
     with _LOCK:
         mark = _counter
         # mark append stays inside the lock: with concurrent queries an
         # interleaved record() would otherwise skew which events
         # last_query() attributes to the newest query
-        _QUERY_MARKS.append(mark)
+        _QUERY_MARKS.append((mark, tid))
     record("query_start", description=description)
     return mark
 
@@ -83,11 +173,33 @@ def recent(n: int = 100) -> List[Dict[str, Any]]:
         return list(_EVENTS)[-n:]
 
 
-def last_query() -> List[Dict[str, Any]]:
-    """Events since the last query_start (inclusive)."""
+def query_events(trace_id: str) -> List[Dict[str, Any]]:
+    """Every ring event stamped with ``trace_id`` (exact attribution,
+    immune to concurrent interleaving)."""
     with _LOCK:
         evs = list(_EVENTS)
-    mark = _QUERY_MARKS[-1] if _QUERY_MARKS else 0
+    return [e for e in evs if e.get("trace_id") == trace_id]
+
+
+def query_marks() -> List[Tuple[int, Optional[str]]]:
+    """(first event counter, trace_id) per started query, oldest
+    first — the per-query folding key for history/ui rollups."""
+    with _LOCK:
+        return list(_QUERY_MARKS)
+
+
+def last_query() -> List[Dict[str, Any]]:
+    """Events of the most recent query. Trace-id keyed when the newest
+    mark has one (events of OTHER concurrent queries are excluded;
+    id-less events inside the positional window are kept so legacy
+    emitters still attribute); pure positional slicing otherwise."""
+    with _LOCK:
+        evs = list(_EVENTS)
+        mark, tid = _QUERY_MARKS[-1] if _QUERY_MARKS else (0, None)
+    if tid is not None:
+        return [e for e in evs
+                if e.get("trace_id") == tid
+                or ("trace_id" not in e and e["n"] >= mark)]
     return [e for e in evs if e["n"] >= mark]
 
 

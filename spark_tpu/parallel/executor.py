@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
 from spark_tpu import conf as CF
+from spark_tpu import trace as _trace
 from spark_tpu import types as T
 from spark_tpu.columnar.batch import Batch
 from spark_tpu.expr import expressions as E
@@ -532,13 +533,16 @@ class MeshExecutor:
 
         d = self.d
         ex = dataclasses.replace(ex, child=D.ShardScanExec(child_sb))
-        stats_sb = self._run_stage(D.ExchangeStatsExec(ex))
-        # replicated psum/pmax: the flat layout puts device 0's copy
-        # first; one host fetch of 2*d int64s total
-        incoming = np.asarray(
-            stats_sb.data.columns[0].data)[:d].astype(np.int64)
-        maxslice = np.asarray(
-            stats_sb.data.columns[1].data)[:d].astype(np.int64)
+        # the AQE host round-trip ROADMAP item 3 wants gone: one span
+        # per stats stage + device->host fetch quantifies it per query
+        with _trace.span("exchange.stats", op=_exchange_op(ex)):
+            stats_sb = self._run_stage(D.ExchangeStatsExec(ex))
+            # replicated psum/pmax: the flat layout puts device 0's
+            # copy first; one host fetch of 2*d int64s total
+            incoming = np.asarray(
+                stats_sb.data.columns[0].data)[:d].astype(np.int64)
+            maxslice = np.asarray(
+                stats_sb.data.columns[1].data)[:d].astype(np.int64)
         bucket = max(1, int(self.conf.get(CF.ADAPTIVE_CAPACITY_BUCKET)))
 
         if (allow_skew and consumer is not None and d > 1
@@ -802,10 +806,11 @@ class MeshExecutor:
         return dataclasses.replace(plan, **fields) if changed else plan
 
     def _run_stage(self, plan: P.PhysicalPlan) -> ShardedBatch:
-        from spark_tpu import metrics
+        from spark_tpu import metrics, trace
 
-        with metrics.stage_timer("stage", mesh=self.d,
-                                 node=plan.node_string()):
+        with trace.span("stage.run", op=type(plan).__name__), \
+                metrics.stage_timer("stage", mesh=self.d,
+                                    node=plan.node_string()):
             sb = self._run_stage_inner(plan)
         # measured output footprint: scheduler admission prefers these
         # over static row-count estimates once a plan has run once
@@ -857,7 +862,17 @@ class MeshExecutor:
                 mesh_size=self.d, platform=key[2]), schema_box)
             _DIST_STAGE_CACHE[key] = entry
         jitted, schema_box = entry
-        data = jitted(tuple(s.sharded.data for s in scans))
+        ctx = _trace.current()
+        if ctx is not None and ctx.sampled:
+            # device time, block_until_ready-bounded, so the span is
+            # device execution and not async dispatch; only a SAMPLED
+            # trace pays the forced sync (results are identical either
+            # way — the host reads the same buffers right after)
+            with _trace.span("stage.device", op=type(plan).__name__):
+                data = jitted(tuple(s.sharded.data for s in scans))
+                data = jax.block_until_ready(data)
+        else:
+            data = jitted(tuple(s.sharded.data for s in scans))
         sb = ShardedBatch(schema_box["schema"], data, self.mesh)
         n_ex = _count_exchange_nodes(plan)
         if n_ex and not self._adaptive_enabled():

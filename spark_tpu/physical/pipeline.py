@@ -34,7 +34,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from spark_tpu import conf as CF
-from spark_tpu import faults, metrics
+from spark_tpu import faults, metrics, trace
 from spark_tpu.metrics import PipelineStats
 
 CHUNK_RETRY_ATTEMPTS = CF.register(
@@ -87,6 +87,10 @@ class ChunkPipeline:
             conf.get(CHUNK_RETRY_ATTEMPTS) if conf is not None
             else CHUNK_RETRY_ATTEMPTS.default))
         self._thread: Optional[threading.Thread] = None
+        # capture the caller's span context so producer-side chunk
+        # spans (pipeline.decode/transfer) join the query's trace even
+        # though they run on the background thread
+        self._trace_ctx = metrics.trace_context()
         if self._depth >= 1:
             self._queue: queue.Queue = queue.Queue(maxsize=self._depth)
             self._cond = threading.Condition()
@@ -119,14 +123,16 @@ class ChunkPipeline:
         for attempt in range(self._retry_attempts):
             try:
                 if item is _SENTINEL:
-                    with st.timed("decode"):
+                    with trace.span("pipeline.decode"), \
+                            st.timed("decode"):
                         faults.inject("pipeline.decode", self._conf)
                         nxt = next(self._source, _SENTINEL)
                     if nxt is _SENTINEL:
                         return _SENTINEL
                     item = nxt
-                faults.inject("pipeline.transfer", self._conf)
-                prepared = self._prepare(item)
+                with trace.span("pipeline.transfer"):
+                    faults.inject("pipeline.transfer", self._conf)
+                    prepared = self._prepare(item)
                 if attempt:
                     metrics.record("fault_recovered", point="pipeline",
                                    how="chunk_retry", attempts=attempt)
@@ -161,6 +167,10 @@ class ChunkPipeline:
     # ---- threaded path -----------------------------------------------------
 
     def _produce(self) -> None:
+        with trace.attach(self._trace_ctx):
+            self._produce_traced()
+
+    def _produce_traced(self) -> None:
         st = self._stats
         try:
             while True:

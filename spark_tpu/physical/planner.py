@@ -374,19 +374,22 @@ def execute(plan: P.PhysicalPlan) -> Batch:
 
 
 def _execute(plan: P.PhysicalPlan) -> Batch:
-    from spark_tpu import metrics
+    from spark_tpu import metrics, trace
 
     if isinstance(plan, P.BatchScanExec):
         return plan.batch
     if _fully_traceable(plan):
-        with metrics.stage_timer("fused", node=plan.node_string()):
+        with trace.span("stage.run", op="fused"), \
+                metrics.stage_timer("fused", node=plan.node_string()):
             return _run_fused(plan)
     child_batches = []
     for c in plan.children():
         b = _execute(c)
         child_batches.append(_maybe_compact(b, c))
-    with metrics.stage_timer("blocking", node=plan.node_string(),
-                             cap_in=[b.capacity for b in child_batches]):
+    with trace.span("stage.run", op=type(plan).__name__), \
+            metrics.stage_timer("blocking", node=plan.node_string(),
+                                cap_in=[b.capacity
+                                        for b in child_batches]):
         return plan.execute_blocking(child_batches)
 
 

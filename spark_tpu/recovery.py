@@ -26,10 +26,11 @@ import os
 import threading
 import time
 import uuid
+from contextlib import nullcontext
 from typing import Callable, Iterator, Optional
 
 from spark_tpu import conf as CF
-from spark_tpu import faults, metrics
+from spark_tpu import faults, metrics, trace
 
 STAGE_MAX_ATTEMPTS = CF.register(
     "spark.stage.maxConsecutiveAttempts", 4,
@@ -272,7 +273,13 @@ def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
     last: Optional[BaseException] = None
     for attempt in range(max(1, attempts)):
         try:
-            out = fn()
+            # re-attempts get their own span so a trace waterfall shows
+            # time lost to recovery, not just the winning attempt
+            rspan = trace.span("fault.retry", point=label,
+                               attempt=attempt) if attempt \
+                else nullcontext()
+            with rspan:
+                out = fn()
             if attempt:
                 metrics.record("fault_recovered", point=label,
                                how="stage_retry", attempts=attempt)

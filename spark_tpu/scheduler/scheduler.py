@@ -92,6 +92,10 @@ class QueryTicket:
         self._charge = 0  # admission bytes currently held
         self._granted = False  # holding an admission grant (charge may
         # legitimately be 0 when storage eviction covered the footprint)
+        # span context of the submitting thread (connect request /
+        # client): workers re-enter it so the whole execution — stages,
+        # faults, retries — attributes to the submitter's trace
+        self._trace_ctx = metrics.trace_context()
 
     # -- client surface ------------------------------------------------------
 
@@ -350,14 +354,28 @@ class QueryScheduler:
             self._execute(t)
 
     def _execute(self, t: QueryTicket) -> None:
+        from spark_tpu import trace
+
+        # worker threads don't inherit the submitter's contextvars;
+        # re-enter the captured span context so the run attributes to
+        # the submitting request's trace (root here for tickets
+        # submitted outside any trace)
+        with trace.attach(t._trace_ctx):
+            with trace.span("scheduler.run", id=t.id, pool=t.pool):
+                self._execute_traced(t)
+
+    def _execute_traced(self, t: QueryTicket) -> None:
+        from spark_tpu import trace
+
         try:
             t.check_cancelled()
-            if t._prepare is not None:
-                # host-side stage: runs concurrently across workers
-                est = t._prepare(t)
-                if est:
-                    t.est_bytes = int(est)
-            self._admit(t)
+            with trace.span("scheduler.queue", id=t.id, pool=t.pool):
+                if t._prepare is not None:
+                    # host-side stage: runs concurrently across workers
+                    est = t._prepare(t)
+                    if est:
+                        t.est_bytes = int(est)
+                self._admit(t)
             t.state = RUNNING
             t.started_t = time.time()
             t.check_cancelled()

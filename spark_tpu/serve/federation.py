@@ -39,11 +39,12 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from spark_tpu import conf as CF
-from spark_tpu import faults, metrics
+from spark_tpu import faults, metrics, trace
 
 #: response headers a replica sets that the router relays verbatim
 RELAY_HEADERS = ("X-Query-Id", "X-Queue-Wait-Ms", "X-Cache",
-                 "Retry-After", "X-SparkTpu-Replica")
+                 "Retry-After", "X-SparkTpu-Replica",
+                 "X-SparkTpu-Trace-Id")
 
 #: connection-level failures that mean "this replica is gone" — the
 #: re-dispatch trigger (same set the connect Client classifies as
@@ -209,7 +210,20 @@ class Federation:
                  ) -> Tuple[int, bytes, Dict[str, str]]:
         """Route one request: pick -> forward, shedding 429s to the
         least-loaded remaining replica and re-dispatching around dead
-        ones (bounded). The return is what the client sees."""
+        ones (bounded). The return is what the client sees. One
+        ``router.dispatch`` span covers the whole routing decision
+        (every shed and re-dispatch attempt stays in the caller's
+        trace); each attempt is a ``router.forward`` child whose
+        context ships to the replica in ``X-SparkTpu-Trace``."""
+        with trace.span("router.dispatch", path=path):
+            return self._dispatch_traced(method, path, body,
+                                         headers, affinity)
+
+    def _dispatch_traced(self, method: str, path: str,
+                         body: Optional[bytes],
+                         headers: Optional[dict] = None,
+                         affinity: Optional[str] = None
+                         ) -> Tuple[int, bytes, Dict[str, str]]:
         try:
             retries = max(0, int(
                 self._conf.get(CF.SERVE_DISPATCH_RETRIES)))
@@ -232,9 +246,18 @@ class Federation:
             metrics.record("serve", phase="dispatch", replica=r.id,
                            path=path)
             try:
-                faults.inject("serve.dispatch", self._conf)
-                code, data, hdr = self.forward(
-                    r, method, path, body, headers)
+                with trace.span("router.forward", replica=r.id):
+                    faults.inject("serve.dispatch", self._conf)
+                    # rewrite (not passthrough) the trace header: the
+                    # replica's spans must parent under THIS forward
+                    # attempt, so shed/re-dispatch attempts stay
+                    # distinguishable in the waterfall
+                    hdrs = dict(headers or {})
+                    hv = trace.header_value()
+                    if hv:
+                        hdrs[trace.TRACE_HEADER] = hv
+                    code, data, hdr = self.forward(
+                        r, method, path, body, hdrs)
             except _CONN_ERRORS as e:
                 last_err = e
                 r.healthy = False

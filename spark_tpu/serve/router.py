@@ -30,10 +30,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
 from spark_tpu import conf as CF
+from spark_tpu import trace
 from spark_tpu.serve.federation import Federation, NoHealthyReplica
 
 #: request headers the router forwards to the chosen replica
-_FORWARD_HEADERS = ("Content-Type", "X-Spark-Pool")
+#: (X-SparkTpu-Trace is a passthrough fallback — Federation.dispatch
+#: rewrites it per forward attempt so replica spans parent correctly)
+_FORWARD_HEADERS = ("Content-Type", "X-Spark-Pool", trace.TRACE_HEADER)
 
 
 class FederationRouter:
@@ -71,6 +74,15 @@ class FederationRouter:
                 fwd = {k: self.headers[k] for k in _FORWARD_HEADERS
                        if self.headers.get(k)}
                 affinity = self.headers.get("X-SparkTpu-Replica")
+                # adopt the client's trace so router.dispatch /
+                # router.forward spans join it (a fresh root otherwise)
+                rctx = trace.from_header(
+                    self.headers.get(trace.TRACE_HEADER))
+                with trace.attach(rctx):
+                    self._dispatch_traced(method, body, fwd, affinity)
+
+            def _dispatch_traced(self, method: str, body, fwd,
+                                 affinity) -> None:
                 try:
                     code, data, hdr = outer.federation.dispatch(
                         method, self.path, body, headers=fwd,
@@ -105,7 +117,8 @@ class FederationRouter:
                     self._send(200, body, "application/json")
                     return
                 if self.path == "/tables" \
-                        or self.path.startswith("/queries"):
+                        or self.path.startswith("/queries") \
+                        or self.path.startswith("/trace/"):
                     self._dispatch("GET")
                     return
                 self._send(404, b"not found", "text/plain")

@@ -15,6 +15,12 @@ event stream from metrics.py. This module glues the two:
   everything executed inside (view with TensorBoard or xprof).
 - ``annotate(name)``: names a region so engine stages are findable
   inside the device trace (TraceAnnotation).
+- ``format_trace()`` / ``trace_breakdown()``: the engine-side span
+  tree from spark_tpu/trace/ as a text waterfall and as a
+  host/queue/device/transfer time split. The two tracing layers
+  compose: spans say WHICH query/stage/chunk owned the wall time,
+  the jax profiler says what the device did inside it (Perfetto loads
+  both — ``history.chrome_trace`` exports the span side).
 - ``query_profile()``: the last query's per-operator wall-time rollup
   from the event stream — the text form of the SQL-tab DAG view.
 - ``pipeline_profile()``: the out-of-HBM chunk pipeline's per-tier
@@ -53,6 +59,94 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def _trace_events(events_or_id=None) -> List[dict]:
+    """Resolve a trace-event source: a trace_id string (exact ring
+    lookup), an event list, or None (the last query's events)."""
+    if isinstance(events_or_id, str):
+        return metrics.query_events(events_or_id)
+    if events_or_id is not None:
+        return list(events_or_id)
+    return metrics.last_query()
+
+
+def format_trace(events_or_id=None, width: int = 40) -> str:
+    """Render one query's span tree as a text waterfall: one line per
+    span, indented by depth, children in start order, with start offset
+    and duration — the terminal form of the Perfetto view
+    (``history.chrome_trace`` is the graphical one). Accepts a
+    trace_id, an event list, or nothing (last query)."""
+    evs = _trace_events(events_or_id)
+    spans = [e for e in evs if e.get("kind") == "span"]
+    if not spans:
+        return "(no span events recorded — tracing off or unsampled)"
+    spans.sort(key=lambda e: float(e.get("t0", 0.0)))
+    ids = {e.get("span_id") for e in spans}
+    children: Dict[Optional[str], List[dict]] = defaultdict(list)
+    roots: List[dict] = []
+    for e in spans:
+        parent = e.get("parent_id")
+        # a parent outside the ring (remote peer's span) makes this a
+        # local root
+        if parent is None or parent not in ids:
+            roots.append(e)
+        else:
+            children[parent].append(e)
+    base = float(roots[0].get("t0", 0.0)) if roots else 0.0
+    lines = [f"trace {spans[0].get('trace_id', '?')}"]
+    attr_skip = ("kind", "name", "ms", "t0", "ts", "tid", "n",
+                 "trace_id", "span_id", "parent_id")
+
+    def walk(e: dict, depth: int) -> None:
+        off = (float(e.get("t0", 0.0)) - base) * 1e3
+        label = ("  " * depth + str(e.get("name", "span")))[:width]
+        attrs = " ".join(
+            f"{k}={v}" for k, v in e.items() if k not in attr_skip)
+        lines.append(f"{label:<{width}} +{off:>8.1f}ms "
+                     f"{float(e.get('ms', 0.0)):>9.2f}ms"
+                     + (f"  {attrs}" if attrs else ""))
+        for c in children.get(e.get("span_id"), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)
+
+
+def trace_breakdown(events_or_id=None) -> Dict[str, float]:
+    """Split one trace's wall time into where it went: ``wall_ms`` is
+    the root span; ``queue_ms`` the scheduler admission wait
+    (scheduler.queue spans), ``device_ms`` the block_until_ready-bounded
+    device execution (stage.device), ``transfer_ms`` the chunk-pipeline
+    host->device staging (pipeline.transfer); ``host_ms`` is the
+    remainder (decode, planning, glue, HTTP) — so the four components
+    sum to wall by construction. Accepts a trace_id, an event list, or
+    nothing (last query)."""
+    evs = _trace_events(events_or_id)
+    spans = [e for e in evs if e.get("kind") == "span"]
+    out = {"wall_ms": 0.0, "queue_ms": 0.0, "device_ms": 0.0,
+           "transfer_ms": 0.0, "host_ms": 0.0}
+    if not spans:
+        return out
+    ids = {e.get("span_id") for e in spans}
+    roots = [e for e in spans if e.get("parent_id") is None
+             or e.get("parent_id") not in ids]
+    out["wall_ms"] = round(max(
+        (float(e.get("ms", 0.0)) for e in roots), default=0.0), 3)
+    sums = {"scheduler.queue": 0.0, "stage.device": 0.0,
+            "pipeline.transfer": 0.0}
+    for e in spans:
+        name = e.get("name")
+        if name in sums:
+            sums[name] += float(e.get("ms", 0.0))
+    out["queue_ms"] = round(sums["scheduler.queue"], 3)
+    out["device_ms"] = round(sums["stage.device"], 3)
+    out["transfer_ms"] = round(sums["pipeline.transfer"], 3)
+    out["host_ms"] = round(max(
+        0.0, out["wall_ms"] - out["queue_ms"] - out["device_ms"]
+        - out["transfer_ms"]), 3)
+    return out
 
 
 def query_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:

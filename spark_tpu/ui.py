@@ -29,6 +29,11 @@ Endpoints:
   /api/v1/mview         materialized views: refresh rollup
                         (incremental/full/fallback), per-view state,
                         stream merge/dedup counters, mview.* gauges
+  /api/v1/trace         query-latency rollup from trace roots: p50/p95,
+                        a log2 latency histogram, the slowest traces
+  /trace/<trace_id>     one trace as Chrome trace-event JSON (same
+                        payload the connect server serves — load in
+                        ui.perfetto.dev)
 
 Enable per session with ``spark.ui.enabled=true`` (port:
 ``spark.ui.port``, 0 = ephemeral) or programmatically::
@@ -89,6 +94,59 @@ def _storage_status(session) -> Optional[dict]:
         return None
 
 
+def _percentile(sorted_vals, p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def _trace_summary(events, top: int = 8) -> dict:
+    """Latency rollup over trace ROOT spans (one per trace): p50/p95,
+    a log2-bucketed histogram, and the slowest traces with their ids —
+    the landing table for 'which query should I open in Perfetto'."""
+    spans = [e for e in events if e.get("kind") == "span"]
+    ids = {e.get("span_id") for e in spans}
+    by_trace: dict = {}
+    for e in spans:
+        parent = e.get("parent_id")
+        if parent is not None and parent in ids:
+            continue  # not a local root
+        t = e.get("trace_id")
+        # a remote parent can leave several local roots in one trace:
+        # keep the longest (the outermost local view of the query)
+        if t not in by_trace or float(e.get("ms", 0.0)) > \
+                float(by_trace[t].get("ms", 0.0)):
+            by_trace[t] = e
+    lat = sorted(float(e.get("ms", 0.0)) for e in by_trace.values())
+    hist = []
+    if lat:
+        edge = 1.0
+        while edge < lat[-1]:
+            edge *= 2
+        edges, e2 = [], 1.0
+        while e2 <= edge:
+            edges.append(e2)
+            e2 *= 2
+        for le in edges:
+            hist.append({"le_ms": le,
+                         "count": sum(1 for v in lat if v <= le)})
+    slowest = sorted(by_trace.values(),
+                     key=lambda e: -float(e.get("ms", 0.0)))[:top]
+    return {
+        "traces": len(by_trace),
+        "p50_ms": round(_percentile(lat, 0.50), 3),
+        "p95_ms": round(_percentile(lat, 0.95), 3),
+        "max_ms": round(lat[-1], 3) if lat else 0.0,
+        "histogram": hist,
+        "slowest": [{"trace_id": e.get("trace_id"),
+                     "root": e.get("name"),
+                     "ms": round(float(e.get("ms", 0.0)), 3),
+                     "t0": e.get("t0")} for e in slowest],
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "spark-tpu-ui/1"
 
@@ -125,6 +183,24 @@ class _Handler(BaseHTTPRequestHandler):
                         f"queued={p['queued']} weight={p['weight']} "
                         f"device_ms={p['device_ms']}"
                         for p in sched["pools"]) + "</pre>")
+                html = html.replace("</body>", block + "</body>") \
+                    if "</body>" in html else html + block
+            ts = _trace_summary(events)
+            if ts["traces"]:
+                rows = "".join(
+                    f"<tr><td>{t['ms']:.1f}</td>"
+                    f"<td>{t['root']}</td>"
+                    f"<td><a href='/trace/{t['trace_id']}'>"
+                    f"{t['trace_id']}</a></td></tr>"
+                    for t in ts["slowest"])
+                block = (
+                    "<h2>Query latency (trace roots)</h2><pre>"
+                    f"traces={ts['traces']} p50={ts['p50_ms']:.1f}ms "
+                    f"p95={ts['p95_ms']:.1f}ms "
+                    f"max={ts['max_ms']:.1f}ms</pre>"
+                    "<table border=1 cellpadding=3><tr><th>ms</th>"
+                    "<th>root</th><th>trace (Perfetto JSON)</th></tr>"
+                    + rows + "</table>")
                 html = html.replace("</body>", block + "</body>") \
                     if "</body>" in html else html + block
             sto = _storage_status(
@@ -227,6 +303,21 @@ class _Handler(BaseHTTPRequestHandler):
                 "gauges": {k: v for k, v in metrics.gauges().items()
                            if k.startswith("mview.")},
             })
+        elif url.path == "/api/v1/trace":
+            from spark_tpu import tracing
+
+            summary = _trace_summary(events)
+            for t in summary["slowest"]:
+                t["breakdown"] = tracing.trace_breakdown(t["trace_id"])
+            self._json(summary)
+        elif url.path.startswith("/trace/"):
+            tid = url.path[len("/trace/"):]
+            evs = metrics.query_events(tid)
+            if not evs:
+                self._send(404, b'{"error": "unknown trace id"}',
+                           "application/json")
+            else:
+                self._json(history.chrome_trace(evs))
         elif url.path == "/api/v1/storage":
             session = getattr(self.server, "spark_session", None)
             sto = _storage_status(session)

@@ -101,6 +101,11 @@ def pytest_configure(config):
         "agg: runtime-adaptive aggregation — cardinality-sketched "
         "strategy switching (partial->final / bypass / hash-partial), "
         "Pallas segmented reductions, byte-identity sweeps")
+    config.addinivalue_line(
+        "markers",
+        "trace: end-to-end query tracing (spark_tpu/trace/) — "
+        "hierarchical spans, cross-replica context propagation, "
+        "Perfetto export, overhead guard")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -109,7 +114,8 @@ def pytest_collection_modifyitems(config, items):
     # hanging tier-1 (tests may still carry their own tighter timeout)
     for item in items:
         if ("compile" in item.keywords or "serve" in item.keywords
-                or "mview" in item.keywords or "agg" in item.keywords) \
+                or "mview" in item.keywords or "agg" in item.keywords
+                or "trace" in item.keywords) \
                 and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):

@@ -1,0 +1,309 @@
+"""End-to-end query tracing (spark_tpu/trace/): hierarchical spans,
+cross-replica context propagation, Perfetto export, and the overhead
+guard.
+
+Covers the PR-11 acceptance scenarios: a q3-shaped plan produces a
+well-formed span tree (single root, no orphans); one trace through a
+2-replica fleet — including the 429-shed re-dispatch path — shares one
+trace_id end to end and renders as valid Chrome trace-event JSON;
+results are byte-identical with tracing on/off/sampled; sampling is
+honored; and always-on tracing stays under the 3% overhead budget.
+"""
+
+import json
+import statistics
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_tpu import conf as CF
+from spark_tpu import history, metrics, trace, tracing
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.connect.server import Client, ConnectServer
+from spark_tpu.scheduler import QueryScheduler
+from spark_tpu.serve import FederationRouter, serve_fleet
+
+pytestmark = [pytest.mark.trace, pytest.mark.timeout(120)]
+
+
+@pytest.fixture
+def trace_conf(spark):
+    """Trace-conf sandbox: spark.tpu.trace.* overrides set inside the
+    test are unset afterwards (tracing reverts to always-on)."""
+    yield spark.conf
+    for k in list(spark.conf._overrides):
+        if k.startswith("spark.tpu.trace"):
+            spark.conf.unset(k)
+
+
+def _write_parquet(path, nrows=64, nkeys=4):
+    t = pa.table({
+        "k": [i % nkeys for i in range(nrows)],
+        "v": [float(i) * 0.5 for i in range(nrows)]})
+    pq.write_table(t, str(path))
+    return str(path)
+
+
+def _ipc_bytes(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _spans(evs):
+    return [e for e in evs if e.get("kind") == "span"]
+
+
+def _roots(spans):
+    ids = {e.get("span_id") for e in spans}
+    return [e for e in spans if e.get("parent_id") is None
+            or e.get("parent_id") not in ids]
+
+
+# ---- registration / satellites ---------------------------------------------
+
+
+def test_trace_conf_keys_registered():
+    for key in ("spark.tpu.trace.enabled",
+                "spark.tpu.trace.sampleRatio"):
+        assert CF.is_registered(key), key
+
+
+def test_trace_marker_gets_deadlock_guard(request):
+    assert request.node.get_closest_marker("timeout") is not None
+
+
+def test_span_names_registry():
+    assert trace.SPAN_NAMES
+    for name in ("router.dispatch", "connect.request", "scheduler.run",
+                 "query.execute", "stage.run", "stage.device",
+                 "pipeline.decode", "pipeline.transfer", "fault.retry"):
+        assert name in trace.SPAN_NAMES, name
+
+
+def test_header_roundtrip_and_malformed_dropped():
+    ctx = trace.SpanContext("ab12" * 4, "cd34" * 2, None, True)
+    got = trace.from_header(ctx.header())
+    assert got is not None
+    assert got.trace_id == ctx.trace_id
+    assert got.span_id == ctx.span_id
+    assert got.sampled is True
+    # a remote parent arrives with no local parent_id
+    assert got.parent_id is None
+    for bad in (None, "", "zz", "a-b", "a-b-c-d", "xyz!-12-1",
+                "--1", "ab12-"):
+        assert trace.from_header(bad) is None, bad
+
+
+# ---- span-tree well-formedness ---------------------------------------------
+
+
+def test_span_tree_well_formed_multi_stage_plan(spark, tmp_path):
+    """A q3-shaped plan (join + aggregate + sort: several stages, an
+    exchange) produces ONE trace whose span tree has exactly one root,
+    no orphaned parent_ids, and per-stage spans."""
+    _write_parquet(tmp_path / "tr_a.parquet", 96, 6)
+    _write_parquet(tmp_path / "tr_b.parquet", 48, 6)
+    spark.read.parquet(str(tmp_path / "tr_a.parquet")) \
+        .createOrReplaceTempView("tr_a")
+    spark.read.parquet(str(tmp_path / "tr_b.parquet")) \
+        .createOrReplaceTempView("tr_b")
+    rows = spark.sql(
+        "SELECT a.k, SUM(a.v + b.v) AS s FROM tr_a a "
+        "JOIN tr_b b ON a.k = b.k GROUP BY a.k ORDER BY s").collect()
+    assert rows
+    evs = metrics.last_query()
+    spans = _spans(evs)
+    assert spans, "tracing is on by default — spans must be recorded"
+    tids = {e.get("trace_id") for e in spans}
+    assert len(tids) == 1
+    roots = _roots(spans)
+    assert len(roots) == 1, [r.get("name") for r in roots]
+    # no orphans: every non-root parent_id is a recorded span
+    ids = {e.get("span_id") for e in spans}
+    for e in spans:
+        if e is not roots[0]:
+            assert e.get("parent_id") in ids, e
+    names = {e.get("name") for e in spans}
+    assert "query.execute" in names
+    assert "stage.run" in names
+    # flat events (stage, exchange) are stamped with the same trace id
+    stages = [e for e in evs if e.get("kind") == "stage"]
+    assert stages
+    assert all(e.get("trace_id") == next(iter(tids)) for e in stages)
+
+
+def test_breakdown_components_sum_to_wall(spark, tmp_path):
+    _write_parquet(tmp_path / "tr_bd.parquet", 64, 4)
+    spark.read.parquet(str(tmp_path / "tr_bd.parquet")) \
+        .createOrReplaceTempView("tr_bd")
+    spark.sql("SELECT k, SUM(v) FROM tr_bd GROUP BY k").collect()
+    bd = tracing.trace_breakdown()
+    assert bd["wall_ms"] > 0
+    total = (bd["queue_ms"] + bd["device_ms"] + bd["transfer_ms"]
+             + bd["host_ms"])
+    # host_ms is the remainder by construction: the split sums to wall
+    # well inside the 10% acceptance bound
+    assert abs(total - bd["wall_ms"]) <= max(0.1 * bd["wall_ms"], 1.0)
+    assert tracing.format_trace().startswith("trace ")
+
+
+def test_chrome_trace_valid_json(spark, tmp_path):
+    _write_parquet(tmp_path / "tr_ct.parquet", 64, 4)
+    spark.read.parquet(str(tmp_path / "tr_ct.parquet")) \
+        .createOrReplaceTempView("tr_ct")
+    spark.sql("SELECT k, SUM(v) FROM tr_ct GROUP BY k").collect()
+    evs = metrics.last_query()
+    tid = next(e["trace_id"] for e in _spans(evs))
+    doc = history.chrome_trace(metrics.query_events(tid))
+    blob = json.dumps(doc)  # must serialize
+    assert json.loads(blob)["displayTimeUnit"] == "ms"
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert {e["name"] for e in xs} >= {"query.execute", "stage.run"}
+
+
+# ---- fleet propagation ------------------------------------------------------
+
+
+def test_fleet_propagation_two_replicas(spark, tmp_path):
+    """One trace spans client -> router -> replica -> scheduler ->
+    stages, and GET /trace/<id> through the router renders it."""
+    _write_parquet(tmp_path / "tr_fl.parquet", 64, 4)
+    spark.read.parquet(str(tmp_path / "tr_fl.parquet")) \
+        .createOrReplaceTempView("tr_fl")
+    fleet = serve_fleet(spark, replicas=2)
+    try:
+        c = Client(fleet.url, timeout=60)
+        rows = c.sql("SELECT k, SUM(v) FROM tr_fl GROUP BY k")
+        assert rows.num_rows
+        assert c.last_trace_id
+        spans = _spans(metrics.query_events(c.last_trace_id))
+        names = {e.get("name") for e in spans}
+        assert names >= {"connect.client", "router.dispatch",
+                         "router.forward", "connect.request",
+                         "scheduler.run", "query.execute", "stage.run"}
+        roots = _roots(spans)
+        assert len(roots) == 1
+        assert roots[0]["name"] == "connect.client"
+        # the Perfetto export fetched over HTTP covers the whole path
+        doc = c.trace()
+        xs = {e["name"] for e in doc["traceEvents"]
+              if e.get("ph") == "X"}
+        assert xs >= {"router.dispatch", "connect.request",
+                      "scheduler.run", "stage.run"}
+    finally:
+        fleet.stop()
+
+
+def test_shed_redispatch_shares_one_trace(spark, tmp_path):
+    """A 429-shed re-dispatch stays in ONE trace: both forward
+    attempts (the saturated replica and the one that served) appear as
+    router.forward spans under the same trace_id."""
+    import urllib.request
+
+    _write_parquet(tmp_path / "tr_sh.parquet", 48, 4)
+    spark.read.parquet(str(tmp_path / "tr_sh.parquet")) \
+        .createOrReplaceTempView("tr_sh")
+    full = ConnectServer(
+        spark, port=0, replica_id="full",
+        scheduler=QueryScheduler(conf=RuntimeConf(
+            {"spark.tpu.scheduler.queueDepth": 0}))).start()
+    ok = ConnectServer(spark, port=0, replica_id="ok").start()
+    router = FederationRouter([full, ok], conf=spark.conf).start()
+    try:
+        req = urllib.request.Request(
+            router.url + "/sql",
+            data=json.dumps(
+                {"query": "SELECT k FROM tr_sh WHERE k > 0"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            tid = resp.headers.get("X-SparkTpu-Trace-Id")
+        assert tid
+        evs = metrics.query_events(tid)
+        forwards = [e for e in _spans(evs)
+                    if e.get("name") == "router.forward"]
+        tried = {e.get("replica") for e in forwards}
+        assert "ok" in tried
+        if "full" in tried:  # round-robin picked the saturated one 1st
+            assert len(forwards) >= 2
+            sheds = [e for e in evs if e.get("kind") == "serve"
+                     and e.get("phase") == "shed"]
+            assert sheds and all(e.get("trace_id") == tid
+                                 for e in sheds)
+    finally:
+        router.stop()
+        full.stop()
+        ok.stop()
+
+
+# ---- byte identity / sampling / overhead ------------------------------------
+
+
+def test_on_off_sweep_byte_identity(spark, tmp_path, trace_conf):
+    """Tracing never touches data: every cell of the on/off/sampled
+    sweep serializes the identical arrow stream."""
+    _write_parquet(tmp_path / "tr_bi.parquet", 96, 6)
+    spark.read.parquet(str(tmp_path / "tr_bi.parquet")) \
+        .createOrReplaceTempView("tr_bi")
+
+    def run():
+        return _ipc_bytes(spark.sql(
+            "SELECT k, SUM(v) AS s FROM tr_bi GROUP BY k ORDER BY k"
+        ).toArrow())
+
+    ref = run()
+    for enabled, ratio in ((True, 1.0), (True, 0.5), (True, 0.0),
+                           (False, 1.0)):
+        trace_conf.set("spark.tpu.trace.enabled", enabled)
+        trace_conf.set("spark.tpu.trace.sampleRatio", ratio)
+        assert run() == ref, (enabled, ratio)
+
+
+def test_sampling_honored(spark, tmp_path, trace_conf):
+    _write_parquet(tmp_path / "tr_sa.parquet", 64, 4)
+    spark.read.parquet(str(tmp_path / "tr_sa.parquet")) \
+        .createOrReplaceTempView("tr_sa")
+
+    def run_and_spans(q):
+        spark.sql(q).collect()
+        return _spans(metrics.last_query())
+
+    trace_conf.set("spark.tpu.trace.sampleRatio", 0.0)
+    assert run_and_spans(
+        "SELECT k, SUM(v) FROM tr_sa GROUP BY k") == []
+    trace_conf.set("spark.tpu.trace.sampleRatio", 1.0)
+    assert run_and_spans(
+        "SELECT k, SUM(v), COUNT(*) FROM tr_sa GROUP BY k")
+    trace_conf.set("spark.tpu.trace.enabled", False)
+    assert run_and_spans(
+        "SELECT k, MAX(v) FROM tr_sa GROUP BY k") == []
+
+
+def test_overhead_under_three_percent(spark, tmp_path, trace_conf):
+    """Always-on tracing costs <3% on a warm q1-shaped query
+    (median-of-alternating-runs; small absolute slack absorbs timer
+    noise on runs this short)."""
+    _write_parquet(tmp_path / "tr_oh.parquet", 256, 8)
+    spark.read.parquet(str(tmp_path / "tr_oh.parquet")) \
+        .createOrReplaceTempView("tr_oh")
+    q = ("SELECT k, SUM(v) AS s, AVG(v) AS a, COUNT(*) AS n "
+         "FROM tr_oh WHERE v >= 0 GROUP BY k ORDER BY k")
+    spark.sql(q).collect()  # warm: compile once, outside the clock
+    on, off = [], []
+    for _ in range(5):
+        for enabled, sink in ((True, on), (False, off)):
+            trace_conf.set("spark.tpu.trace.enabled", enabled)
+            t0 = time.perf_counter()
+            spark.sql(q).collect()
+            sink.append(time.perf_counter() - t0)
+    med_on = statistics.median(on)
+    med_off = statistics.median(off)
+    assert med_on <= med_off * 1.03 + 0.010, (med_on, med_off)

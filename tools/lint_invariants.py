@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST linter for spark_tpu codebase invariants.
 
-Five rules the engine relies on but Python cannot enforce:
+Six rules the engine relies on but Python cannot enforce:
 
 1. **conf-keys** — every string key passed to ``conf.get(...)`` /
    ``conf.set(...)`` (and builder ``.config(...)``) that looks like a
@@ -14,6 +14,12 @@ Five rules the engine relies on but Python cannot enforce:
    ``faults.inject("<point>", ...)`` must be one of ``faults.POINTS``;
    a typo'd point would make a fault-injection site unreachable while
    tests believe it is covered.
+
+6. **span-names** — every string literal passed to
+   ``trace.span("<name>", ...)`` must be declared in
+   ``spark_tpu.trace.SPAN_NAMES`` (same discipline as conf keys and
+   fault points); an undeclared span name fragments the waterfall and
+   the host/device attribution that key off the registry.
 
 3. **fingerprint-purity** — functions on the structural-fingerprint
    path (compile/store.py and planner._stable_adaptive_snapshot) must
@@ -64,7 +70,9 @@ DEFAULT_CONFIG = {
     },
     "locked_modules": [os.path.join("spark_tpu", "metrics.py")],
     # module state -> lock that must guard its mutations
-    "lock_map": {"_PATH_CACHE": "_IO_LOCK"},
+    "lock_map": {"_PATH_CACHE": "_IO_LOCK", "_LOG_BUF": "_IO_LOCK",
+                 "_LOG_BUF_PATH": "_IO_LOCK",
+                 "_LOG_LAST_FLUSH": "_IO_LOCK"},
     "default_lock": "_LOCK",
 }
 
@@ -183,6 +191,39 @@ def _check_dead_fault_points(seen: Set[str],
             f"fault point {point!r} is declared in faults.POINTS but "
             "has no faults.inject(...) call site under the linted "
             "paths — arming it would silently test nothing"))
+
+
+# ---- rule 6: span names -----------------------------------------------------
+
+
+def _check_span_names(tree: ast.AST, rel: str,
+                      out: List[Finding]) -> None:
+    """Every literal span name opened via ``trace.span("<name>", ...)``
+    (or a bare imported ``span("<name>", ...)``) must be declared in
+    the central ``spark_tpu.trace.SPAN_NAMES`` registry."""
+    from spark_tpu import trace
+
+    valid: Set[str] = set(trace.SPAN_NAMES)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if not (fn.attr == "span" and isinstance(base, ast.Name)
+                    and base.id in ("trace", "_trace")):
+                continue
+        elif isinstance(fn, ast.Name) and fn.id == "span":
+            pass
+        else:
+            continue
+        name = _const_str(node.args[0])
+        if name is not None and name not in valid:
+            out.append(Finding(
+                "span-names", rel, node.lineno,
+                f"span name {name!r} is not declared in "
+                "spark_tpu.trace.SPAN_NAMES — register it so the "
+                "waterfall/attribution rollups see it"))
 
 
 # ---- rule 3: fingerprint purity ---------------------------------------------
@@ -349,6 +390,7 @@ def run_lint(config: Optional[dict] = None) -> List[Finding]:
             continue
         _check_conf_keys(tree, rel, cfg, findings)
         _check_fault_points(tree, rel, findings, injected_points)
+        _check_span_names(tree, rel, findings)
         if rel in fingerprint:
             _check_fingerprint_purity(tree, rel, fingerprint[rel],
                                       findings)
