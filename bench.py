@@ -145,6 +145,13 @@ MVIEW_MODE = os.environ.get("BENCH_MVIEW", "1") == "1"
 # the result JSON; needs BENCH_MASTER=mesh[N] to engage)
 AGG_MODE = os.environ.get("BENCH_AGG", "1") == "1"
 
+# BENCH_JOIN=0 skips the hybrid-hash-join A/B (an out-of-core join run
+# at the full memory budget, at 1/8 of it through the grant-driven
+# hybrid join's planned spilling, and through the old reactive OOM
+# ladder; replan counts + spill bytes + timing + byte-identity land
+# under 'join' in the result JSON)
+JOIN_MODE = os.environ.get("BENCH_JOIN", "1") == "1"
+
 # BENCH_TRACE=0 skips the tracing-overhead A/B (q1/q3 timed with the
 # span layer off vs always-on vs 10%-sampled; overhead % + byte-identity
 # + the host/device/queue/transfer breakdown of one traced q3 land
@@ -908,6 +915,27 @@ def main():
                    "agg": agg_ab,
                    "robustness": _robustness_counters()})
 
+    join_ab = None
+    if JOIN_MODE:
+        if _wall_remaining() <= 5:
+            join_ab = {"error": "skipped: wall budget exhausted",
+                       "phase": "join"}
+        else:
+            print("[bench] join A/B: grant-driven hybrid hash join at "
+                  "full vs 1/8 memory budget vs the old OOM ladder",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_query_deadline()):
+                    join_ab = _run_join_ab(spark)
+            except _QueryTimeout:
+                join_ab = {"error": "timeout"}
+            except Exception as e:
+                join_ab = {"error": f"{type(e).__name__}: {e}"}
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "join": join_ab,
+                   "robustness": _robustness_counters()})
+
     trace_ab = None
     if TRACE_MODE:
         if _wall_remaining() <= 5:
@@ -965,6 +993,7 @@ def main():
         **({"serve": serve_ab} if serve_ab is not None else {}),
         **({"mview": mview} if mview is not None else {}),
         **({"agg": agg_ab} if agg_ab is not None else {}),
+        **({"join": join_ab} if join_ab is not None else {}),
         **({"trace": trace_ab} if trace_ab is not None else {}),
         **({"analysis": analysis_overhead}
            if analysis_overhead is not None else {}),
@@ -1155,6 +1184,106 @@ def _run_agg_ab(spark) -> dict:
     finally:
         conf.unset("spark.tpu.adaptive.agg.enabled")
         conf.unset("spark.tpu.adaptive.enabled")
+    return out
+
+
+def _run_join_ab(spark) -> dict:
+    """Hybrid-hash-join A/B: one out-of-core fact/dim join (SF0.1-ish:
+    300k fact rows against a 20k-key dim, both sides over the device
+    batch budget so the tier-3 join engages) run three ways —
+
+    - ``hybrid_full``:  hybrid join, full memory budget (the grant
+      covers staging: everything stays resident, zero spills);
+    - ``hybrid_1_8``:   hybrid join, budget cut to 1/8 of the staged
+      bytes (planned spilling: a single pass that spills the
+      partitions beyond the grant, still ZERO ladder replans);
+    - ``ladder``:       hybrid off and the whole-batch execution killed
+      with an injected device OOM — the old reactive path, which pays
+      >= 1 ladder replan (a wasted device execution) for the same
+      memory pressure.
+
+    Per arm the JSON records wall ms, the recovery replan count, spill
+    bytes/partitions, the bytes granted by the unified memory manager,
+    and byte-identity against the resident reference run."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_tpu import metrics
+
+    rng = np.random.default_rng(17)
+    n = int(os.environ.get("BENCH_JOIN_ROWS", "300000"))
+    ndim = 20_000
+    tmp = tempfile.mkdtemp(prefix="bench_join_")
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, ndim, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })
+    dim = pa.table({
+        "dk": pa.array(np.arange(ndim, dtype=np.int64)),
+        "w": pa.array((np.arange(ndim) % 997).astype(np.int64)),
+    })
+    fp = os.path.join(tmp, "fact.parquet")
+    dp = os.path.join(tmp, "dim.parquet")
+    pq.write_table(fact, fp)
+    pq.write_table(dim, dp)
+    spark.read.parquet(fp).createOrReplaceTempView("bj_fact")
+    spark.read.parquet(dp).createOrReplaceTempView("bj_dim")
+    sql = ("select sum(v * w) as s, count(*) as c "
+           "from bj_fact join bj_dim on k = dk")
+    conf = spark.conf
+    staged = fact.nbytes + dim.nbytes
+    out = {"rows": n, "staged_bytes": int(staged)}
+    try:
+        # resident reference (default budget, default batch bytes)
+        t0 = time.perf_counter()
+        base = [(r.s, r.c) for r in spark.sql(sql).collect()]
+        out["resident_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+
+        def arm(budget, hybrid, inject_oom, batch_bytes):
+            if batch_bytes is not None:
+                conf.set("spark.tpu.maxDeviceBatchBytes", batch_bytes)
+            conf.set("spark.tpu.join.hybrid.enabled", hybrid)
+            conf.set("spark.tpu.scheduler.hbmBudgetBytes", budget)
+            if inject_oom:
+                conf.set("spark.tpu.faultInjection.execute.device",
+                         "nth:1:oom")
+            try:
+                metrics.reset_join()
+                metrics.reset_recovery()
+                t0 = time.perf_counter()
+                got = [(r.s, r.c) for r in spark.sql(sql).collect()]
+                ms = (time.perf_counter() - t0) * 1000.0
+                js = metrics.join_stats()
+                return {
+                    "wall_ms": round(ms, 1),
+                    "replans": metrics.recovery_stats()["replans"],
+                    "spill_bytes": js["spill_bytes"],
+                    "spilled_partitions": js["spilled_partitions"],
+                    "granted_bytes": js["grant_bytes"],
+                    "byte_identical": got == base,
+                }
+            finally:
+                conf.unset("spark.tpu.maxDeviceBatchBytes")
+                conf.unset("spark.tpu.join.hybrid.enabled")
+                conf.unset("spark.tpu.scheduler.hbmBudgetBytes")
+                conf.unset("spark.tpu.faultInjection.execute.device")
+
+        # both sides over a 256 KiB batch budget -> tier-3 hybrid join
+        out["hybrid_full"] = arm(2 << 30, True, False, 256 * 1024)
+        if _wall_remaining() > 5:
+            out["hybrid_1_8"] = arm(max(1 << 16, staged // 8), True,
+                                    False, 256 * 1024)
+        if _wall_remaining() > 5:
+            # old path: resident execution dies with OOM, the reactive
+            # ladder replans into the chunked tier
+            out["ladder"] = arm(max(1 << 16, staged // 8), False,
+                                True, None)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
     return out
 
 

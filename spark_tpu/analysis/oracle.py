@@ -223,6 +223,21 @@ def capacity_diagnostics(estimates: List[NodeEstimate],
     diags: List[Diagnostic] = []
     for e in estimates:
         if e.device_bytes > budget:
+            if e.node.startswith("Join"):
+                # joins no longer ride the replan ladder: the hybrid
+                # hash join stages to its memory grant and spills the
+                # rest as a planned single pass
+                hint = ("an over-budget join executes as a planned "
+                        "single pass via the grant-driven hybrid hash "
+                        "join (spark.tpu.join.hybrid.*), spilling "
+                        "partitions beyond its memory grant; add join "
+                        "keys or filters, or raise "
+                        "spark.tpu.scheduler.hbmBudgetBytes, to avoid "
+                        "the spill traffic")
+            else:
+                hint = ("this plan will rely on the chunked/OOM-"
+                        "degradation ladder; add join keys or filters, "
+                        "or raise spark.tpu.scheduler.hbmBudgetBytes")
             diags.append(Diagnostic(
                 code="PLAN-CAP-BLOWUP", level="warn",
                 node=e.node,
@@ -230,7 +245,5 @@ def capacity_diagnostics(estimates: List[NodeEstimate],
                     f"static footprint {e.device_bytes} bytes "
                     f"(capacity {e.capacity} x {e.row_bytes} B/row) "
                     f"exceeds the HBM admission budget {budget}"),
-                hint=("this plan will rely on the chunked/OOM-"
-                      "degradation ladder; add join keys or filters, "
-                      "or raise spark.tpu.scheduler.hbmBudgetBytes")))
+                hint=hint))
     return diags

@@ -52,6 +52,18 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          strategy — the sketch is advisory, its result
                          is discarded on failure, so even a 'corrupt'
                          sketch cannot change bytes
+- ``join.spill``         the hybrid hash join's host-spill seams
+                         (physical/chunked.py _HybridHashJoinAgg):
+                         spill-file WRITE during the partition pass,
+                         spill-file READ-BACK during the join pass, and
+                         the recursive repartition of an overflowing
+                         bucket. transient/hang retry up to
+                         spark.tpu.join.hybrid.spillRetryAttempts;
+                         corrupt (or retry exhaustion) falls back one
+                         rung down the ladder — the static grace-hash
+                         join recomputed from source, byte-identical;
+                         oom surfaces to the OOM degradation ladder
+                         (the LAST resort)
 
 Spec grammar (the conf value):
 
@@ -106,6 +118,7 @@ POINTS = (
     "serve.dispatch",
     "mview.refresh",
     "agg.strategy",
+    "join.spill",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

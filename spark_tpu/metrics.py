@@ -436,6 +436,67 @@ def reset_mview() -> None:
             _MVIEW[k] = 0
 
 
+# ---- hybrid-hash-join counters ----------------------------------------------
+
+#: the grant-driven dynamic hybrid hash join (physical/chunked.py
+#: _HybridHashJoinAgg) — grants taken from the unified memory manager
+#: (and their byte total), zero-byte grants (storage pins starved the
+#: join: everything spills), mid-pass resident-set grows, partitions
+#: demoted to host spill files (and the bytes written), spill file
+#: writes/read-backs, bounded retries at the join.spill seams, recursive
+#: repartitions of overflowing buckets, and fallbacks one rung down to
+#: the static grace-hash join. Shown in tracing.storage_profile and
+#: /api/v1/storage (via the manager snapshot) plus the hybrid_hash_agg
+#: event per join.
+_JOIN = {"grants": 0, "grant_bytes": 0, "zero_grants": 0, "grows": 0,
+         "spilled_partitions": 0, "spill_bytes": 0, "spill_writes": 0,
+         "spill_reads": 0, "spill_retries": 0,
+         "recursive_repartitions": 0, "fallbacks": 0}
+
+
+def note_join(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _JOIN[kind] = _JOIN.get(kind, 0) + int(n)
+
+
+def join_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_JOIN)
+
+
+def reset_join() -> None:
+    with _LOCK:
+        for k in list(_JOIN):
+            _JOIN[k] = 0
+
+
+# ---- recovery / OOM-ladder counters -----------------------------------------
+
+#: the reactive recovery layer (recovery.py) — ``replans`` counts every
+#: OOM-ladder re-execution (rung 0 forced-adaptive retry plus each
+#: halved-budget chunked attempt): the number a planned single-pass
+#: hybrid join keeps at ZERO where the old halve-and-retry path pays
+#: one wasted device execution per rung. ``ladder_exhausted`` counts
+#: queries that fell off the floor of the ladder.
+_RECOVERY = {"replans": 0, "ladder_exhausted": 0}
+
+
+def note_recovery(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _RECOVERY[kind] = _RECOVERY.get(kind, 0) + int(n)
+
+
+def recovery_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_RECOVERY)
+
+
+def reset_recovery() -> None:
+    with _LOCK:
+        for k in list(_RECOVERY):
+            _RECOVERY[k] = 0
+
+
 class PipelineStats:
     """Wall-time accounting for the out-of-HBM chunk pipeline
     (physical/pipeline.py): per-stage totals (decode / filter /

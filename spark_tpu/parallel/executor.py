@@ -58,6 +58,27 @@ _DIST_STAGE_CACHE = LruDict("dist", CF.JIT_STAGE_CACHE_ENTRIES)
 FORCE_ADAPTIVE: contextvars.ContextVar = contextvars.ContextVar(
     "spark_tpu_force_adaptive", default=False)
 
+
+def hll_estimate(registers: np.ndarray) -> float:
+    """HyperLogLog distinct estimate from register maxima: harmonic
+    mean alpha_m * m^2 / sum(2^-M_j), with the standard linear-counting
+    correction (m * ln(m / V), V = zero registers) in the small range
+    where raw HLL biases high (Flajolet et al. 2007, the same
+    corrections the reference's HyperLogLogPlusPlusHelper applies).
+    Module-level so both the device sketch (the adaptive-aggregation
+    stats stage below) and the hybrid hash join's host-side partition
+    oracle (physical/chunked.py) share one estimator."""
+    m = int(registers.size)
+    if m == 0:
+        return 0.0
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / float(
+        np.sum(np.power(2.0, -registers.astype(np.float64))))
+    zeros = int((registers == 0).sum())
+    if est <= 2.5 * m and zeros:
+        est = m * math.log(m / zeros)
+    return float(est)
+
 #: exchange kinds the AQE pass cuts into separate stages (broadcast /
 #: single-partition exchanges use the all_gather data plane — there is
 #: no (D, cap) routing buffer to shrink, so they stay fused)
@@ -597,24 +618,10 @@ class MeshExecutor:
         except Exception:
             return True
 
-    @staticmethod
-    def _hll_estimate(registers: np.ndarray) -> float:
-        """HyperLogLog distinct estimate from register maxima: harmonic
-        mean alpha_m * m^2 / sum(2^-M_j), with the standard
-        linear-counting correction (m * ln(m / V), V = zero registers)
-        in the small range where raw HLL biases high (Flajolet et al.
-        2007, the same corrections the reference's
-        HyperLogLogPlusPlusHelper applies)."""
-        m = int(registers.size)
-        if m == 0:
-            return 0.0
-        alpha = 0.7213 / (1.0 + 1.079 / m)
-        est = alpha * m * m / float(
-            np.sum(np.power(2.0, -registers.astype(np.float64))))
-        zeros = int((registers == 0).sum())
-        if est <= 2.5 * m and zeros:
-            est = m * math.log(m / zeros)
-        return float(est)
+    #: see the module-level hll_estimate — kept as a staticmethod so
+    #: existing callers/tests keep working while the hybrid hash join
+    #: shares the estimator without instantiating an executor
+    _hll_estimate = staticmethod(hll_estimate)
 
     def _adaptive_aggregate(self, final: "D.DistSortAggExec",
                             ex: "D.HashPartitionExchangeExec",

@@ -26,11 +26,20 @@ tiers, all built on the same merge-state decomposition streaming uses
    actually pays: the host->device tunnel), and the key's min/max range
    is pushed into the parquet scan for row-group pruning.
 
-3. **Grace-hash join** (`_GraceHashAgg`): both sides over budget. Both
-   scans hash-partition by join key into P host-RAM bucket sets (one
-   streaming pass each); each bucket pair then joins on device as an
-   ordinary sub-budget plan. Every key lands in exactly one bucket, so
-   inner/outer/semi semantics all hold bucket-locally.
+3. **Hybrid hash join** (`_HybridHashJoinAgg`, default;
+   `spark.tpu.join.hybrid.*`): both sides over budget. A planned
+   single pass at ANY memory level — build staging requests a grant
+   from the unified memory manager, partitions spill to host files
+   beyond the granted bytes (growing from the free span first),
+   overflowing buckets recursively repartition, and the final result
+   is byte-identical to the static tier below. The static
+   **grace-hash join** (`_GraceHashAgg`) survives as the
+   hybrid-disabled path and the fallback rung when a spill seam fails
+   unrecoverably: both scans hash-partition by join key into P
+   host-RAM bucket sets (one streaming pass each); each bucket pair
+   then joins on device as an ordinary sub-budget plan. Every key
+   lands in exactly one bucket, so inner/outer/semi semantics all
+   hold bucket-locally.
 
 plus **streamed top-k** (`_ChunkedTopK`): Limit(Sort(big scan)) keeps a
 running device top-(n+offset) merged per chunk.
@@ -86,6 +95,36 @@ SEMI_FILTER_EXACT_MAX = CF.register(
 GRACE_PARTITIONS_MAX = CF.register(
     "spark.tpu.gracePartitionsMax", 256,
     "Upper bound on grace-hash join partition count.", int)
+
+JOIN_HYBRID_ENABLED = CF.register(
+    "spark.tpu.join.hybrid.enabled", True,
+    "Route both-sides-over-budget joins through the grant-driven "
+    "dynamic hybrid hash join (_HybridHashJoinAgg): build staging is "
+    "sized to bytes actually GRANTED by the unified memory manager, "
+    "overflow partitions spill to host files as a planned single pass, "
+    "and overflowing buckets recursively repartition instead of "
+    "relying on the OOM degradation ladder. Off = the static grace-"
+    "hash join (which also remains the fallback rung when a hybrid "
+    "spill seam fails unrecoverably).", bool)
+
+JOIN_HYBRID_PARTITIONS_MAX = CF.register(
+    "spark.tpu.join.hybrid.partitionsMax", 256,
+    "Upper bound on the hybrid hash join's top-level partition count. "
+    "Buckets that still exceed the device budget (skew, or a cap this "
+    "low) recursively repartition with a per-level hash salt.", int)
+
+JOIN_HYBRID_SPILL_RETRIES = CF.register(
+    "spark.tpu.join.hybrid.spillRetryAttempts", 2,
+    "Bounded retries for one hybrid-join spill operation (spill-file "
+    "write, spill-file read-back, recursive repartition) on a "
+    "transient/deadline failure before the join falls back one rung "
+    "to the static grace-hash join recomputed from source.", int)
+
+JOIN_HYBRID_GROW_WHEN_IDLE = CF.register(
+    "spark.tpu.join.hybrid.growWhenIdle", True,
+    "Let the hybrid hash join grow its resident set mid-pass from the "
+    "unified memory manager's FREE span (never by evicting storage) "
+    "before demoting a partition to a host spill file.", bool)
 
 # join types through which a big LEFT / RIGHT child may stream
 _STREAM_LEFT = ("inner", "cross", "left", "left_semi", "left_anti")
@@ -264,6 +303,24 @@ class _MergeState:
         with stats_recording_disabled():
             self.batch = self._run(plan)
         self.chunks += 1
+
+
+def _merge_plan_for(spec: AggSpec):
+    """The device merge step shared by every chunked tier: re-aggregate
+    the union of the running state and one chunk's partials."""
+    keys = tuple(E.Col(n) for n in spec.key_names)
+    merge_outs = tuple(E.Alias(E.Col(n), n)
+                       for n in spec.key_names) + tuple(spec.merges)
+
+    def merge_plan(state_rel, partial):
+        if state_rel is None:
+            return L.Aggregate(keys, merge_outs, partial)
+        aligned = L.Project(
+            tuple(E.Col(n) for n in state_rel.schema.names), partial)
+        return L.Aggregate(keys, merge_outs,
+                           L.Union(state_rel, aligned))
+
+    return merge_plan
 
 
 def _int_key_values(batch, col: str) -> Optional[np.ndarray]:
@@ -550,21 +607,7 @@ class _ChunkedAgg:
                     byte_budget=prefetch_budget, stats=stats,
                     nbytes_of=rel_nbytes, conf=conf)
 
-            keys = tuple(E.Col(n) for n in spec.key_names)
-            merge_outs = tuple(E.Alias(E.Col(n), n)
-                               for n in spec.key_names) \
-                + tuple(spec.merges)
-
-            def merge_plan(state_rel, partial):
-                if state_rel is None:
-                    return L.Aggregate(keys, merge_outs, partial)
-                aligned = L.Project(
-                    tuple(E.Col(n) for n in state_rel.schema.names),
-                    partial)
-                return L.Aggregate(keys, merge_outs,
-                                   L.Union(state_rel, aligned))
-
-            state = _MergeState(merge_plan, run_fn)
+            state = _MergeState(_merge_plan_for(spec), run_fn)
             progress = _progress_logger("chunked_agg")
             for rel in pipe:
                 with stats.timed("compute"):
@@ -693,19 +736,7 @@ class _GraceHashAgg:
         spec = AggSpec(self.agg.groupings, self.agg.aggregates)
         key_aliases = tuple(E.Alias(g, n) for g, n
                             in zip(spec.groupings_exec, spec.key_names))
-        keys = tuple(E.Col(n) for n in spec.key_names)
-        merge_outs = tuple(E.Alias(E.Col(n), n)
-                           for n in spec.key_names) + tuple(spec.merges)
-
-        def merge_plan(state_rel, partial):
-            if state_rel is None:
-                return L.Aggregate(keys, merge_outs, partial)
-            aligned = L.Project(
-                tuple(E.Col(n) for n in state_rel.schema.names), partial)
-            return L.Aggregate(keys, merge_outs,
-                               L.Union(state_rel, aligned))
-
-        state = _MergeState(merge_plan, run_fn)
+        state = _MergeState(_merge_plan_for(spec), run_fn)
         import pyarrow as pa
 
         def concat(parts, scan):
@@ -770,6 +801,490 @@ class _GraceHashAgg:
         metrics.record("grace_hash_agg", partitions=nparts,
                        chunks=state.chunks, pipeline_depth=depth,
                        **stats.finish())
+
+        if state.batch is None:
+            final0: L.LogicalPlan = L.Aggregate(
+                self.agg.groupings, self.agg.aggregates,
+                _splice(self.agg.child,
+                        {id(self.scan_a): _empty_rel(self.scan_a),
+                         id(self.scan_b): _empty_rel(self.scan_b)}))
+            for node in reversed(self.above):
+                final0 = node.with_children((final0,))
+            return run_fn(final0)
+        final: L.LogicalPlan = L.Project(tuple(spec.outputs),
+                                         L.Relation(state.batch))
+        for node in reversed(self.above):
+            final = node.with_children((final,))
+        return run_fn(final)
+
+
+# recursive-repartition bounds: an overflowing bucket splits 4 ways per
+# level under a fresh hash salt; recursion stops once a bucket fits the
+# device budget, shrinks below the row floor (device can chunk it), has
+# a single hot key (splitting cannot help), or hits the depth cap.
+_RECURSE_FANOUT = 4
+_RECURSE_MAX_DEPTH = 8
+_RECURSE_MIN_ROWS = 4096
+
+#: HLL registers for the host-side distinct sketch maintained during
+#: the hybrid join's partition pass (same estimator as the adaptive
+#: aggregation sketch — parallel/executor.hll_estimate)
+_HLL_REGISTERS = 256
+
+
+def _session_memory_manager():
+    """The active session's UnifiedMemoryManager, or None standalone
+    (e.g. a bare MeshExecutor in tests) — the hybrid join then stages
+    fully resident, exactly like the static grace join."""
+    try:
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession._active
+        return getattr(sess, "memory_manager", None)
+    except Exception:
+        return None
+
+
+def _hll_update(registers: np.ndarray, vals: np.ndarray) -> None:
+    """Fold one chunk's join-key values into the HLL registers, host
+    side: register index from the hash's low bits, rank from the
+    leading-zero count of the remaining 56 bits (via float log2 — a
+    +/-1 rank error near powers of two is noise for a sketch)."""
+    h = vals.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    idx = (h & np.uint64(_HLL_REGISTERS - 1)).astype(np.int64)
+    rest = (h >> np.uint64(8)).astype(np.float64)
+    msb = np.floor(np.log2(np.maximum(rest, 1.0)))
+    rank = np.where(rest > 0, 56.0 - msb, 57.0).astype(np.int64)
+    np.maximum.at(registers, idx, rank)
+
+
+class _HybridSpillAbort(Exception):
+    """A ``join.spill`` seam exhausted its retries or hit corruption:
+    the hybrid pass discards its partial state and falls back ONE rung
+    to the static grace-hash join, recomputed from source."""
+
+    def __init__(self, op: str, kind: str):
+        super().__init__(f"hybrid hash join {op} aborted ({kind})")
+        self.op = op
+        self.kind = kind
+
+
+def _spill_seam(conf, op: str, attempts: int, fn):
+    """Run one spill-side operation behind the ``join.spill`` fault
+    point. transient/hang faults retry up to ``attempts`` times;
+    corruption or retry exhaustion aborts the hybrid pass (the caller
+    falls back to the static grace-hash join); OOM propagates so the
+    degradation ladder stays the LAST resort. The injection fires
+    BEFORE ``fn`` touches any file, so a retried injected fault never
+    sees partial writes; real mid-write I/O errors are not transient
+    and abort to the recompute-from-source fallback."""
+    from spark_tpu import faults, metrics, recovery, trace
+
+    attempts = max(0, int(attempts))
+    last: Optional[BaseException] = None
+    for attempt in range(attempts + 1):
+        try:
+            with trace.span("join.spill", op=op, attempt=attempt):
+                faults.inject("join.spill", conf)
+                return fn()
+        except Exception as e:
+            if recovery.is_oom(e):
+                raise
+            if recovery.is_transient(e) and attempt < attempts:
+                last = e
+                metrics.note_join("spill_retries")
+                metrics.record("stage_retry", label=f"join.spill.{op}",
+                               attempt=attempt, error=repr(e))
+                continue
+            raise _HybridSpillAbort(
+                op, getattr(e, "kind", type(e).__name__)) from e
+    raise _HybridSpillAbort(
+        op, getattr(last, "kind", "exhausted")) from last
+
+
+class _HybridPart:
+    """One side of one hybrid-join partition: resident arrow tables
+    while it fits the grant, a write-through host spill file after
+    demotion."""
+
+    __slots__ = ("tables", "rows", "nbytes", "path", "sink", "writer",
+                 "spilled")
+
+    def __init__(self):
+        self.tables: Optional[list] = []
+        self.rows = 0
+        self.nbytes = 0
+        self.path: Optional[str] = None
+        self.sink = None
+        self.writer = None
+        self.spilled = False
+
+
+@dataclasses.dataclass
+class _HybridHashJoinAgg:
+    """Tier 3, dynamic: grant-driven hybrid hash join.
+
+    Where the static ``_GraceHashAgg`` stages BOTH sides fully in host
+    RAM and hopes, this tier executes the same join as a planned single
+    pass at ANY memory level:
+
+    1. **Grant.** Before touching data it requests an execution grant
+       from the session's UnifiedMemoryManager, sized by the MEASURED
+       build bytes of a prior run of the same plan shape
+       (admission.seeded_build_bytes — the AQE feedback loop) or the
+       planner estimate. The grant is what the staging pass may keep
+       resident; a 0-byte grant means everything spills (the join still
+       completes in one planned pass — it never blocks on storage).
+    2. **Partition pass.** Both scans stream once, hash-bucketed with
+       the grace hash. Partitions accumulate resident until the grant
+       is exhausted; then the join first tries to GROW the grant from
+       the manager's free span (growWhenIdle — never evicting storage)
+       and otherwise demotes the largest resident partition to a
+       write-through arrow-IPC spill file. A host-side HLL distinct
+       sketch of the join keys is maintained during the pass.
+    3. **Join pass.** Partitions execute in index order (resident
+       directly, spilled read back), feeding the same device merge
+       state as grace — results are byte-identical. A bucket pair whose
+       working set would blow the device budget is recursively
+       REPARTITIONED with a per-level hash salt instead of
+       shipped-and-hoped, so the OOM ladder becomes the last resort
+       rather than the sizing mechanism.
+
+    Every spill-file write, read-back, and recursive repartition is a
+    ``join.spill`` fault seam with bounded retries; unrecoverable seam
+    failures fall back one rung to the static grace join recomputed
+    from source. Observed staging bytes are fed back to admission, so
+    the NEXT run's grant is measured, not estimated."""
+
+    above: List[L.LogicalPlan]
+    agg: L.Aggregate
+    join: L.Join
+    scan_a: L.UnresolvedScan
+    scan_b: L.UnresolvedScan
+    key_a: str
+    key_b: str
+    est_total: int
+
+    _MIX = np.uint64(0x9E3779B97F4A7C15)
+
+    def execute(self, conf, run_fn):
+        from spark_tpu import metrics
+
+        try:
+            return self._execute_hybrid(conf, run_fn)
+        except _HybridSpillAbort as e:
+            metrics.note_join("fallbacks")
+            metrics.record("fault_recovered", point="join.spill",
+                           fault=e.kind, op=e.op,
+                           action="grace_fallback")
+            return _GraceHashAgg(
+                self.above, self.agg, self.join, self.scan_a,
+                self.scan_b, self.key_a, self.key_b,
+                self.est_total).execute(conf, run_fn)
+
+    def _execute_hybrid(self, conf, run_fn):
+        import os
+        import shutil
+        import tempfile
+
+        import pyarrow as pa
+
+        from spark_tpu import metrics, trace
+        from spark_tpu.columnar.arrow import arrow_to_numpy
+        from spark_tpu.columnar.batch import from_numpy, round_capacity
+        from spark_tpu.io.datasource import _pa_schema_from_schema
+        from spark_tpu.parallel.executor import hll_estimate
+        from spark_tpu.physical.pipeline import ChunkPipeline
+        from spark_tpu.scheduler import admission
+
+        budget = conf.get(MAX_DEVICE_BATCH_BYTES)
+        chunk_rows = conf.get(CHUNK_ROWS)
+        depth = conf.get(CF.PIPELINE_DEPTH)
+        prefetch_budget = conf.get(CF.PREFETCH_BYTES_MAX)
+        retries = int(conf.get(JOIN_HYBRID_SPILL_RETRIES))
+        grow_idle = bool(conf.get(JOIN_HYBRID_GROW_WHEN_IDLE))
+        stats = metrics.PipelineStats()
+        nparts = int(min(conf.get(JOIN_HYBRID_PARTITIONS_MAX),
+                         max(2, -(-4 * self.est_total
+                                  // max(budget, 1)))))
+
+        manager = _session_memory_manager()
+        charge = 0
+        resident_cap: Optional[int] = None  # None = ungoverned
+        if manager is not None:
+            request = admission.seeded_build_bytes(self.agg,
+                                                   self.est_total)
+            charge = manager.acquire_execution(request)
+            resident_cap = charge
+            metrics.note_join("grants")
+            metrics.note_join("grant_bytes", charge)
+            if charge == 0:
+                metrics.note_join("zero_grants")
+        granted0 = charge
+
+        parts_a = [_HybridPart() for _ in range(nparts)]
+        parts_b = [_HybridPart() for _ in range(nparts)]
+        registers = np.zeros(_HLL_REGISTERS, dtype=np.int64)
+        counters = {"resident": 0, "staged": 0, "spill_bytes": 0,
+                    "max_depth": 0}
+        tmpdir: Optional[str] = None
+
+        def spill_write(side, p, part, tables):
+            nbytes = sum(t.nbytes for t in tables)
+
+            def _do():
+                nonlocal tmpdir
+                if part.writer is None:
+                    if tmpdir is None:
+                        tmpdir = tempfile.mkdtemp(
+                            prefix="spark-tpu-hybrid-join-")
+                    part.path = os.path.join(tmpdir,
+                                             f"{side}{p}.arrows")
+                    part.sink = pa.OSFile(part.path, "wb")
+                    part.writer = pa.ipc.new_stream(part.sink,
+                                                    tables[0].schema)
+                for t in tables:
+                    part.writer.write_table(t)
+
+            _spill_seam(conf, "write", retries, _do)
+            metrics.note_join("spill_writes")
+            metrics.note_join("spill_bytes", nbytes)
+            counters["spill_bytes"] += nbytes
+
+        def demote_one() -> int:
+            """Spill the largest resident partition wholesale; returns
+            the resident bytes freed (0 when nothing is demotable)."""
+            best = None
+            for side, plist in (("a", parts_a), ("b", parts_b)):
+                for p, part in enumerate(plist):
+                    if part.tables and (best is None
+                                        or part.nbytes > best[2].nbytes):
+                        best = (side, p, part)
+            if best is None:
+                return 0
+            side, p, part = best
+            tables, freed = part.tables, part.nbytes
+            part.tables, part.nbytes = [], 0
+            if not part.spilled:
+                part.spilled = True
+                metrics.note_join("spilled_partitions")
+            spill_write(side, p, part, tables)
+            return freed
+
+        def partition_side(side, scan, key_col, plist):
+            nonlocal charge, resident_cap
+            for tbl in scan.source.iter_batches(
+                    scan.columns, scan.filters, chunk_rows):
+                vals = _decode_key_np(tbl.column(key_col))
+                if vals is None:
+                    raise NotImplementedError(
+                        "hybrid hash join needs an integral "
+                        "partition key")
+                _hll_update(registers, vals)
+                h = ((vals.astype(np.uint64) * self._MIX)
+                     >> np.uint64(32)) % np.uint64(nparts)
+                h = h.astype(np.int64)
+                for p in np.unique(h):
+                    part = plist[p]
+                    sub = tbl.filter(h == p)
+                    part.rows += sub.num_rows
+                    counters["staged"] += sub.nbytes
+                    if part.spilled:  # write-through: stays spilled
+                        spill_write(side, p, part, [sub])
+                        continue
+                    part.tables.append(sub)
+                    part.nbytes += sub.nbytes
+                    counters["resident"] += sub.nbytes
+                # planned spilling: keep staged bytes inside the grant
+                # — grow from the manager's free span when allowed,
+                # demote the largest partition otherwise
+                while resident_cap is not None \
+                        and counters["resident"] > resident_cap:
+                    need = counters["resident"] - resident_cap
+                    if grow_idle and manager is not None:
+                        got = manager.try_grow(need)
+                        if got:
+                            charge += got
+                            resident_cap += got
+                            metrics.note_join("grows")
+                            continue
+                    freed = demote_one()
+                    if freed == 0:
+                        break  # nothing demotable: run over-grant
+                    counters["resident"] -= freed
+
+        def close_writers():
+            for plist in (parts_a, parts_b):
+                for part in plist:
+                    if part.writer is not None:
+                        part.writer.close()
+                        part.sink.close()
+                        part.writer = part.sink = None
+
+        def read_back(part) -> "pa.Table":
+            def _do():
+                with pa.OSFile(part.path, "rb") as f:
+                    return pa.ipc.open_stream(f).read_all()
+
+            tbl = _spill_seam(conf, "read", retries, _do)
+            metrics.note_join("spill_reads")
+            return tbl
+
+        def materialize(part, scan) -> "pa.Table":
+            if part.spilled:
+                return read_back(part)
+            if not part.tables:
+                return _pa_schema_from_schema(scan.schema).empty_table()
+            return pa.concat_tables(part.tables)
+
+        spec = AggSpec(self.agg.groupings, self.agg.aggregates)
+        key_aliases = tuple(E.Alias(g, n) for g, n
+                            in zip(spec.groupings_exec, spec.key_names))
+        state = _MergeState(_merge_plan_for(spec), run_fn)
+        outer = self.join.how in ("left", "right", "full")
+
+        def keep_pair(has_a: bool, has_b: bool) -> bool:
+            if not has_a and not has_b:
+                return False
+            if not outer and (not has_a or not has_b):
+                return self.join.how == "left_anti" and has_a
+            return True
+
+        try:
+            with trace.span("join.partition", partitions=nparts,
+                            granted=granted0):
+                # sequential sides (grace runs them concurrently):
+                # spill/grow decisions against the shared grant stay
+                # deterministic, so spill counts are reproducible
+                partition_side("a", self.scan_a, self.key_a, parts_a)
+                partition_side("b", self.scan_b, self.key_b, parts_b)
+                close_writers()
+
+            # ONE power-of-two capacity ladder per side: top-level caps
+            # from the largest bucket, sub-buckets reuse the
+            # _chunk_capacity buckets below it (bounded program count)
+            cap_a = round_capacity(
+                max([p.rows for p in parts_a] + [1]))
+            cap_b = round_capacity(
+                max([p.rows for p in parts_b] + [1]))
+            parts = [p for p in range(nparts)
+                     if keep_pair(parts_a[p].rows > 0,
+                                  parts_b[p].rows > 0)]
+
+            def to_device(ta, tb):
+                with stats.timed("decode"):
+                    sa, aa, va = arrow_to_numpy(ta)
+                    sb, ab, vb = arrow_to_numpy(tb)
+                with stats.timed("transfer"):
+                    ba = from_numpy(
+                        sa, aa, va,
+                        capacity=_chunk_capacity(
+                            max(ta.num_rows, 1), cap_a),
+                        narrow_transfer=True).block_until_ready()
+                    bb = from_numpy(
+                        sb, ab, vb,
+                        capacity=_chunk_capacity(
+                            max(tb.num_rows, 1), cap_b),
+                        narrow_transfer=True).block_until_ready()
+                return {id(self.scan_a): L.Relation(ba),
+                        id(self.scan_b): L.Relation(bb)}
+
+            def split_bucket(ta, tb, level, out):
+                pair = ta.nbytes + tb.nbytes
+                if (4 * pair <= budget
+                        or level >= _RECURSE_MAX_DEPTH
+                        or max(ta.num_rows,
+                               tb.num_rows) <= _RECURSE_MIN_ROWS):
+                    out.append(to_device(ta, tb))
+                    return
+                ka = _decode_key_np(ta.column(self.key_a)) \
+                    if ta.num_rows else None
+                if ka is not None and len(np.unique(ka)) <= 1:
+                    # single hot key: splitting cannot help; ship it
+                    out.append(to_device(ta, tb))
+                    return
+
+                def _do():
+                    salt = np.uint64(2 * level + 3)
+
+                    def rehash(tbl, col):
+                        if tbl.num_rows == 0:
+                            return [tbl] * _RECURSE_FANOUT
+                        vals = _decode_key_np(tbl.column(col))
+                        h = ((vals.astype(np.uint64) * self._MIX
+                              * salt) >> np.uint64(32)) \
+                            % np.uint64(_RECURSE_FANOUT)
+                        h = h.astype(np.int64)
+                        return [tbl.filter(h == i)
+                                for i in range(_RECURSE_FANOUT)]
+
+                    return (rehash(ta, self.key_a),
+                            rehash(tb, self.key_b))
+
+                subs_a, subs_b = _spill_seam(conf, "repartition",
+                                             retries, _do)
+                metrics.note_join("recursive_repartitions")
+                counters["max_depth"] = max(counters["max_depth"],
+                                            level + 1)
+                for i in range(_RECURSE_FANOUT):
+                    if keep_pair(subs_a[i].num_rows > 0,
+                                 subs_b[i].num_rows > 0):
+                        split_bucket(subs_a[i], subs_b[i],
+                                     level + 1, out)
+
+            def prepare(p):
+                ta = materialize(parts_a[p], self.scan_a)
+                tb = materialize(parts_b[p], self.scan_b)
+                parts_a[p].tables = parts_b[p].tables = None  # free RAM
+                out: list = []
+                split_bucket(ta, tb, 0, out)
+                return out or None
+
+            pipe = ChunkPipeline(
+                parts, prepare, depth=depth,
+                byte_budget=prefetch_budget, stats=stats,
+                nbytes_of=lambda ms: sum(
+                    r.batch.device_nbytes()
+                    for m in ms for r in m.values()),
+                conf=conf)
+            progress = _progress_logger("hybrid_hash_agg")
+            try:
+                for mappings in pipe:
+                    for mapping in mappings:
+                        with stats.timed("compute"):
+                            chunk_plan = _splice(self.agg.child,
+                                                 mapping)
+                            partial = L.Aggregate(
+                                tuple(spec.groupings_exec),
+                                key_aliases + tuple(spec.partials),
+                                chunk_plan)
+                            state.feed(partial)
+                    progress(state.chunks, 0, stats)
+            finally:
+                pipe.close()
+        finally:
+            close_writers()
+            if tmpdir is not None:
+                shutil.rmtree(tmpdir, ignore_errors=True)
+            if manager is not None:
+                manager.release_execution(charge)
+
+        spilled = sum(1 for plist in (parts_a, parts_b)
+                      for pt in plist if pt.spilled)
+        metrics.record(
+            "hybrid_hash_agg", partitions=nparts,
+            spilled_parts=spilled,
+            resident_parts=2 * nparts - spilled,
+            granted_bytes=granted0, grown_bytes=charge - granted0,
+            staged_bytes=counters["staged"],
+            spill_bytes=counters["spill_bytes"],
+            depth=counters["max_depth"],
+            ndv=int(hll_estimate(registers)),
+            chunks=state.chunks, pipeline_depth=depth,
+            **stats.finish())
+        # AQE feedback: the NEXT run of this plan shape requests a
+        # grant sized by what staging actually took
+        admission.note_measured_bytes(self.agg, counters["staged"])
 
         if state.batch is None:
             final0: L.LogicalPlan = L.Aggregate(
@@ -879,7 +1394,7 @@ def find_chunkable(plan: L.LogicalPlan, conf):
     above, node = _peel_above(plan)
 
     if isinstance(node, L.Aggregate):
-        return _find_agg(above, node, budget)
+        return _find_agg(above, node, budget, conf)
 
     # top-k tier: Project* (Limit (Sort (per-row (big scan))))
     above2: List[L.LogicalPlan] = []
@@ -907,7 +1422,7 @@ def find_chunkable(plan: L.LogicalPlan, conf):
     return _ChunkedTopK(above2, lim, sort, chain, node)
 
 
-def _find_agg(above, agg: L.Aggregate, budget: int):
+def _find_agg(above, agg: L.Aggregate, budget: int, conf=None):
     # cheap structural pre-check via the shared legality rule set
     # (analysis/legality.py) before paying for full AggSpec planning;
     # AggSpec itself enforces the same verdicts
@@ -949,14 +1464,14 @@ def _find_agg(above, agg: L.Aggregate, budget: int):
 
     if len(big) == 2:
         gh = _find_grace(above, agg, big[0][0], big[1][0],
-                         big[0][1] + big[1][1])
+                         big[0][1] + big[1][1], conf)
         if gh is not None:
             return gh
     return None
 
 
 def _find_grace(above, agg: L.Aggregate, sa: L.UnresolvedScan,
-                sb: L.UnresolvedScan, est_total: int):
+                sb: L.UnresolvedScan, est_total: int, conf=None):
     """Shape check for tier 3: one join under the aggregate separates
     the two big scans, with only per-row ops between."""
     # find the join whose sides split {sa, sb}
@@ -997,7 +1512,10 @@ def _find_grace(above, agg: L.Aggregate, sa: L.UnresolvedScan,
         if not (getattr(dt, "is_integral", False)
                 or isinstance(dt, (T.DateType, T.DecimalType))):
             return None
-    return _GraceHashAgg(above, agg, join, sa, sb, ka, kb, est_total)
+    hybrid = bool(conf.get(JOIN_HYBRID_ENABLED)) if conf is not None \
+        else bool(JOIN_HYBRID_ENABLED.default)
+    cls = _HybridHashJoinAgg if hybrid else _GraceHashAgg
+    return cls(above, agg, join, sa, sb, ka, kb, est_total)
 
 
 def execute_chunked(found, conf, run_fn):

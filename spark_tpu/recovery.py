@@ -148,15 +148,79 @@ def is_transient(exc: BaseException) -> bool:
     return False
 
 
+def _note_measured_resident(lp) -> None:
+    """Seed admission's measured-bytes table keyed by the OPTIMIZED
+    plan after a successful resident run (DataFrame._execute keys by
+    the RAW plan; the grant pre-step and the hybrid join see the
+    optimized plan, so both keys must be populated)."""
+    try:
+        from spark_tpu.scheduler import admission
+
+        peak = max((int(e.get("bytes", 0))
+                    for e in metrics.last_query()
+                    if e.get("kind") == "stage_bytes"), default=0)
+        admission.note_measured_bytes(lp, peak)
+    except Exception:
+        pass  # observability must never fail the query
+
+
+def _grant_planned_chunk(lp, conf):
+    """Planned degradation BEFORE execution — the zero-replan path.
+    When a MEASURED prior run of this plan shape says its working set
+    exceeds what the unified memory manager could currently offer
+    (storage pins, shrunken budget), re-plan through the chunked tier
+    NOW at the available span instead of letting the device OOM and
+    walking the replan ladder. Measured bytes only: static estimates
+    are too noisy to pre-chunk on. Returns ``(found, shadow_conf)`` or
+    ``(None, None)``."""
+    from spark_tpu.physical.chunked import JOIN_HYBRID_ENABLED
+    from spark_tpu.scheduler import admission
+
+    try:
+        if not bool(conf.get(JOIN_HYBRID_ENABLED)):
+            return None, None
+        from spark_tpu.api.session import SparkSession
+
+        sess = SparkSession._active
+        manager = getattr(sess, "memory_manager", None) \
+            if sess is not None else None
+        if manager is None:
+            return None, None
+        measured = admission.measured_plan_bytes(lp)
+        if not measured:
+            return None, None
+        # free-for-execution span; the query's own eventual grant is
+        # deliberately not modeled — storage is what it cannot evict
+        # past, so that is the planning bound
+        with manager.lock:
+            avail = manager.budget - manager.storage_bytes()
+        if avail <= 0 or int(measured) <= avail:
+            return None, None
+    except Exception:
+        return None, None
+    found, shadow = plan_chunk_first(lp, conf, avail)
+    if found is None:
+        return None, None
+    metrics.record("planned_chunked", budget=avail,
+                   measured=int(measured))
+    return found, shadow
+
+
 def run_plan_with_oom_degradation(lp, conf, run_fn):
     """Execute an optimized logical plan with the HBM-pressure
     degradation ladder: plans whose scans exceed the device budget run
-    chunked as before; a whole-batch (or chunked) execution that dies
-    with OOM is re-planned through ``find_chunkable``/
-    ``execute_chunked`` at a halved ``spark.tpu.maxDeviceBatchBytes``,
-    halving again on repeat down to ``spark.tpu.oomDegrade.floorBytes``
-    — so memory pressure degrades to the out-of-HBM tier instead of
-    failing the query. ``run_fn(plan) -> Batch`` is the raw engine."""
+    chunked as before; a plan whose MEASURED working set exceeds what
+    the unified memory manager can currently grant is pre-planned into
+    the chunked tier (``planned_chunked`` — zero replans); a
+    whole-batch (or chunked) execution that dies with OOM is
+    re-planned through ``find_chunkable``/``execute_chunked`` at a
+    halved ``spark.tpu.maxDeviceBatchBytes``, halving again on repeat
+    down to ``spark.tpu.oomDegrade.floorBytes`` — so memory pressure
+    degrades to the out-of-HBM tier instead of failing the query.
+    Every ladder replan bumps ``metrics.recovery_stats()['replans']``
+    and chains the triggering exception as ``__cause__`` so the final
+    error carries the whole replan history. ``run_fn(plan) -> Batch``
+    is the raw engine."""
     from spark_tpu.conf import RuntimeConf
     from spark_tpu.physical.chunked import (MAX_DEVICE_BATCH_BYTES,
                                             execute_chunked,
@@ -164,11 +228,18 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
 
     try:
         found = find_chunkable(lp, conf)
+        chunk_conf = conf
+        if found is None:
+            found, shadow = _grant_planned_chunk(lp, conf)
+            if found is not None:
+                chunk_conf = shadow
         if found is not None:
-            return execute_chunked(found, conf, run_fn)
+            return execute_chunked(found, chunk_conf, run_fn)
         # the whole-batch device execution seam
         faults.inject("execute.device", conf)
-        return run_fn(lp)
+        out = run_fn(lp)
+        _note_measured_resident(lp)
+        return out
     except Exception as e:
         if not (conf.get(OOM_DEGRADE_ENABLED) and is_oom(e)):
             raise
@@ -194,6 +265,7 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
                         or bool(conf.get(_mex.CF.ADAPTIVE_ENABLED)))
     if adaptive_off and sess is not None \
             and getattr(sess, "_mesh", None) is not None:
+        metrics.note_recovery("replans")
         metrics.record("degraded_to_adaptive", error=repr(last))
         token = _mex.FORCE_ADAPTIVE.set(True)
         try:
@@ -204,6 +276,8 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
         except Exception as e2:
             if not is_oom(e2):
                 raise
+            if e2.__cause__ is None and e2 is not last:
+                e2.__cause__ = last  # replan history rides the chain
             last = e2  # adaptive compaction was not enough: chunk
         finally:
             _mex.FORCE_ADAPTIVE.reset(token)
@@ -221,6 +295,7 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
         if found is None:
             continue  # still under the halved budget: halve again
         attempted = True
+        metrics.note_recovery("replans")
         metrics.record("degraded_to_chunked", budget=budget,
                        error=repr(last))
         try:
@@ -228,11 +303,14 @@ def run_plan_with_oom_degradation(lp, conf, run_fn):
         except Exception as e2:
             if not is_oom(e2):
                 raise
+            if e2.__cause__ is None and e2 is not last:
+                e2.__cause__ = last  # replan history rides the chain
             last = e2  # chunked tier still OOMs: halve again
             continue
         metrics.record("fault_recovered", point="execute.device",
                        how="degraded_to_chunked", budget=budget)
         return out
+    metrics.note_recovery("ladder_exhausted")
     if not attempted:
         # no budget made the plan chunkable (e.g. an in-memory relation
         # with no file-backed scan): the ladder has nothing to offer —

@@ -108,6 +108,20 @@ def estimate_plan_bytes(plan, conf) -> int:
     return max(MIN_ESTIMATE_BYTES, est)
 
 
+def seeded_build_bytes(plan, fallback: int) -> int:
+    """Grant request for the hybrid hash join's build staging: the
+    MEASURED peak footprint of this plan shape when a prior run (AQE)
+    recorded one, else the planner's static estimate passed as
+    ``fallback``. Deliberately does NOT fall through to the device
+    batch budget the way estimate_plan_bytes does — an unknown join
+    should request what the planner believes, not a 5 GiB default that
+    would evict the whole cache for nothing."""
+    measured = measured_plan_bytes(plan)
+    if measured is not None and measured > 0:
+        return max(MIN_ESTIMATE_BYTES, int(measured))
+    return max(MIN_ESTIMATE_BYTES, int(fallback))
+
+
 class AdmissionController:
     """Byte-budget gate over the EXECUTION side of the unified
     storage/execution memory manager (storage/unified.py — the

@@ -61,6 +61,17 @@ class UnifiedMemoryManager:
         self._admitted = 0
         self._store = None  # MemoryStore registers itself
         self.evicted_for_execution = 0  # entries evicted to admit queries
+        # grant observability (all mutated under self.lock): a join/
+        # query starved by storage pins must be visible from the
+        # snapshot (surfaced via /api/v1/storage and
+        # tracing.storage_profile), not only from its wall time
+        self.grants = 0            # acquire_execution calls
+        self.grant_bytes = 0       # total bytes actually granted
+        self.grant_waits = 0       # fits_execution said "not yet"
+        self.grant_denials = 0     # grants short of the request
+        self.zero_grants = 0       # non-zero request granted 0 bytes
+        self.grows = 0             # mid-execution try_grow successes
+        self.grow_denials = 0      # try_grow found no free span
 
     # -- live-conf properties ------------------------------------------------
 
@@ -111,7 +122,10 @@ class UnifiedMemoryManager:
             avail = self.budget - self._execution - self.storage_bytes()
             if charge <= avail:
                 return True
-            return charge <= avail + self._storage_freeable_locked()
+            if charge <= avail + self._storage_freeable_locked():
+                return True
+            self.grant_waits += 1
+            return False
 
     def acquire_execution(self, nbytes: int) -> int:
         """Charge the budget, evicting unpinned storage (LRU, down to
@@ -129,10 +143,39 @@ class UnifiedMemoryManager:
                     reason="execution")
                 avail = self.budget - self._execution \
                     - self.storage_bytes()
+            requested = charge
             charge = max(0, min(charge, avail))
             self._execution += charge
             self._admitted += 1
+            self.grants += 1
+            self.grant_bytes += charge
+            if charge < requested:
+                self.grant_denials += 1
+            if charge == 0 and int(nbytes) > 0:
+                self.zero_grants += 1
             return charge
+
+    def try_grow(self, nbytes: int) -> int:
+        """Grow a live execution grant by up to ``nbytes``, but ONLY
+        from the genuinely free span — never by evicting storage (a
+        mid-query grow must not churn the cache the way the initial
+        grant may). Returns the bytes actually added (0 when storage/
+        other queries hold everything); caller adds the return value to
+        the charge it will ``release_execution``. This is the hybrid
+        hash join's grow-when-idle step: resident partitions expand
+        into memory nobody is using instead of spilling."""
+        with self.lock:
+            nbytes = max(0, int(nbytes))
+            avail = max(0, self.budget - self._execution
+                        - self.storage_bytes())
+            got = min(nbytes, avail)
+            if got > 0:
+                self._execution += got
+                self.grows += 1
+                self.grant_bytes += got
+            elif nbytes > 0:
+                self.grow_denials += 1
+            return got
 
     def release_execution(self, charge: int) -> None:
         with self.lock:
@@ -180,4 +223,13 @@ class UnifiedMemoryManager:
                 "storage_max_bytes": self.max_storage,
                 "free_bytes": max(0, self.budget - self._execution
                                   - self.storage_bytes()),
+                "grants": {
+                    "grants": self.grants,
+                    "grant_bytes": self.grant_bytes,
+                    "grant_waits": self.grant_waits,
+                    "grant_denials": self.grant_denials,
+                    "zero_grants": self.zero_grants,
+                    "grows": self.grows,
+                    "grow_denials": self.grow_denials,
+                },
             }

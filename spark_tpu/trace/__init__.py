@@ -78,6 +78,8 @@ SPAN_NAMES = frozenset({
     "result_cache.probe",   # serve-tier plan-keyed result cache probe
     "mview.probe",          # materialized-view / cache-manager probe
     "storage.pin",          # HBM pin-scope around query execution
+    "join.partition",       # hybrid hash join: grant + partition pass
+    "join.spill",           # hybrid hash join: one spill write/read
 })
 
 

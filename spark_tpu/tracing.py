@@ -180,7 +180,8 @@ def format_profile(profile: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(lines)
 
 
-_PIPELINE_EVENTS = ("chunked_agg", "chunked_topk", "grace_hash_agg")
+_PIPELINE_EVENTS = ("chunked_agg", "chunked_topk", "grace_hash_agg",
+                    "hybrid_hash_agg")
 
 
 def pipeline_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
@@ -442,6 +443,13 @@ def format_storage_profile(profile: Optional[Dict[str, dict]] = None) -> str:
             f"occupancy: storage={mem['storage_bytes']} "
             f"execution={mem['in_use_bytes']} "
             f"free={mem['free_bytes']} / budget={mem['budget_bytes']}")
+        gr = mem.get("grants")
+        if gr:
+            lines.append(
+                f"grants: count={gr['grants']} bytes={gr['grant_bytes']} "
+                f"waits={gr['grant_waits']} denials={gr['grant_denials']} "
+                f"zero={gr['zero_grants']} grows={gr['grows']} "
+                f"grow_denials={gr['grow_denials']}")
     st = p.get("store")
     if st:
         lines.append(

@@ -226,6 +226,8 @@ def test_grace_hash_join_aggregation(spark, join_parquet):
     want = [(r.s, r.n) for r in spark.sql(sql).collect()]
     spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)  # both "big"
     spark.conf.set("spark.tpu.chunkRows", 32_768)
+    # pin the static grace tier (the hybrid join's fallback rung)
+    spark.conf.set("spark.tpu.join.hybrid.enabled", False)
     try:
         metrics.reset()
         got = [(r.s, r.n) for r in spark.sql(sql).collect()]
@@ -234,6 +236,7 @@ def test_grace_hash_join_aggregation(spark, join_parquet):
     finally:
         spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
         spark.conf.unset("spark.tpu.chunkRows")
+        spark.conf.unset("spark.tpu.join.hybrid.enabled")
     assert got == want
 
 
@@ -244,12 +247,14 @@ def test_grace_hash_left_join(spark, join_parquet):
            "from oc_fact left join oc_dim on k = dk")
     want = [(r.n, r.m) for r in spark.sql(sql).collect()]
     spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    spark.conf.set("spark.tpu.join.hybrid.enabled", False)
     try:
         metrics.reset()
         got = [(r.n, r.m) for r in spark.sql(sql).collect()]
         assert _chunk_events("grace_hash_agg")
     finally:
         spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.join.hybrid.enabled")
     assert got == want
 
 
@@ -332,7 +337,8 @@ def test_pipeline_depth_sweep_grace_hash(spark, join_parquet):
     by_depth = {}
     for depth in (0, 1, 2):
         _with_oc_conf(spark, depth, maxDeviceBatchBytes=1024,
-                      chunkRows=32_768)
+                      chunkRows=32_768,
+                      **{"spark.tpu.join.hybrid.enabled": False})
         try:
             metrics.reset()
             by_depth[depth] = [(r.s, r.n)
@@ -341,7 +347,7 @@ def test_pipeline_depth_sweep_grace_hash(spark, join_parquet):
             assert evs and evs[-1]["partitions"] >= 2
             assert evs[-1]["pipeline_depth"] == depth
         finally:
-            _unset_oc_conf(spark)
+            _unset_oc_conf(spark, "spark.tpu.join.hybrid.enabled")
     assert by_depth[0] == want  # chunked == resident (integer sums)
     assert by_depth[1] == by_depth[0]
     assert by_depth[2] == by_depth[0]
@@ -548,3 +554,304 @@ def test_skewed_left_join_split_parity(spark):
         else:
             want_n += 1
     assert (r["n"], r["m"], r["s"]) == (want_n, want_m, want_s)
+
+
+# -- grant-driven hybrid hash join -----------------------------------------
+
+
+@pytest.mark.parametrize("dist", ["uniform", "skewed"])
+def test_hybrid_budget_ladder_byte_identity(spark, tmp_path, dist):
+    """The hybrid join is byte-identical to the resident plan at EVERY
+    grant level — unconstrained (all partitions stay resident),
+    constrained (some spill) and near-floor (almost everything spills)
+    — for uniform and 90%-one-key skewed key distributions."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_tpu import metrics
+
+    rng = np.random.default_rng(41)
+    n = 60_000
+    if dist == "uniform":
+        ks = rng.integers(0, 1000, n)
+    else:  # 90% of rows share key 70 (a dim-matched key)
+        ks = np.where(rng.random(n) < 0.9, 70,
+                      rng.integers(0, 1000, n))
+    fact = pa.table({"k": pa.array(ks.astype(np.int64), pa.int64()),
+                     "v": pa.array(rng.integers(0, 100, n), pa.int64())})
+    dim = pa.table({"dk": pa.array(np.arange(100) * 10, pa.int64()),
+                    "w": pa.array(np.arange(100) * 2, pa.int64())})
+    fp = str(tmp_path / f"hyf_{dist}.parquet")
+    dp = str(tmp_path / f"hyd_{dist}.parquet")
+    pq.write_table(fact, fp)
+    pq.write_table(dim, dp)
+    spark.read.parquet(fp).createOrReplaceTempView("hy_fact")
+    spark.read.parquet(dp).createOrReplaceTempView("hy_dim")
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from hy_fact join hy_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]  # resident
+    assert want[0][1] > 0
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    spark.conf.set("spark.tpu.join.hybrid.partitionsMax", 16)
+    try:
+        for budget, expect_spill in ((2 << 30, False),
+                                     (512 * 1024, True),
+                                     (96 * 1024, True)):
+            spark.conf.set("spark.tpu.scheduler.hbmBudgetBytes", budget)
+            metrics.reset()
+            metrics.reset_join()
+            got = [(r.s, r.n) for r in spark.sql(sql).collect()]
+            assert got == want, (dist, budget)  # EXACT integer sums
+            evs = _chunk_events("hybrid_hash_agg")
+            assert evs and evs[-1]["partitions"] >= 2
+            js = metrics.join_stats()
+            assert js["grants"] >= 1
+            if expect_spill:
+                assert evs[-1]["spilled_parts"] >= 1
+                assert js["spill_writes"] >= 1
+                assert js["spill_reads"] >= 1
+                assert evs[-1]["granted_bytes"] <= budget
+            else:
+                assert evs[-1]["spilled_parts"] == 0
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+        spark.conf.unset("spark.tpu.join.hybrid.partitionsMax")
+        spark.conf.unset("spark.tpu.scheduler.hbmBudgetBytes")
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_hybrid_device_sweep_byte_identity(spark, tmp_path, devices):
+    """find_chunkable routes to the hybrid join and the result matches
+    a host-side oracle exactly on 1-, 2- and 8-device meshes (the
+    per-bucket feeds ride whatever executor run_fn wraps)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_tpu import conf as _conf
+    from spark_tpu.parallel.executor import MeshExecutor
+    from spark_tpu.parallel.mesh import make_mesh
+    from spark_tpu.physical.chunked import (_HybridHashJoinAgg,
+                                            execute_chunked,
+                                            find_chunkable)
+    from spark_tpu.plan.optimizer import optimize
+
+    rng = np.random.default_rng(43)
+    n = 20_000
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 500, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })
+    # 100 rows x 2 int64 cols = 1.6 KB: over the 1 KiB budget below,
+    # so BOTH sides are "big" and the tier-3 hybrid join engages
+    dim = pa.table({
+        "dk": pa.array(np.arange(100) * 10, pa.int64()),
+        "w": pa.array(np.arange(100) * 2, pa.int64()),
+    })
+    fp, dp = str(tmp_path / "dsf.parquet"), str(tmp_path / "dsd.parquet")
+    pq.write_table(fact, fp)
+    pq.write_table(dim, dp)
+    spark.read.parquet(fp).createOrReplaceTempView("ds_fact")
+    spark.read.parquet(dp).createOrReplaceTempView("ds_dim")
+    wmap = {int(k): int(w) for k, w in
+            zip(dim["dk"].to_pylist(), dim["w"].to_pylist())}
+    hits = [int(v) * wmap[int(k)] for k, v in
+            zip(fact["k"].to_pylist(), fact["v"].to_pylist())
+            if int(k) in wmap]
+    want = (sum(hits), len(hits))
+
+    df = spark.sql("select sum(v * w) as s, count(*) as n "
+                   "from ds_fact join ds_dim on k = dk")
+    conf = _conf.RuntimeConf()
+    conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    conf.set("spark.tpu.chunkRows", 16_384)
+    conf.set("spark.tpu.join.hybrid.partitionsMax", 4)
+    found = find_chunkable(optimize(df._plan), conf)
+    assert isinstance(found, _HybridHashJoinAgg)
+    ex = MeshExecutor(make_mesh(devices))
+    out = execute_chunked(found, conf, lambda p: ex.execute_logical(p))
+    row = out.to_pylist()[0]
+    assert (row["s"], row["n"]) == want
+
+
+def test_hybrid_recursive_repartition_depth(spark, join_parquet):
+    """Two coarse partitions over a 200k-row fact force the recursive
+    repartition at least two levels deep; results stay exact."""
+    from spark_tpu import metrics
+
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    spark.conf.set("spark.tpu.chunkRows", 65_536)
+    spark.conf.set("spark.tpu.join.hybrid.partitionsMax", 2)
+    try:
+        metrics.reset()
+        metrics.reset_join()
+        got = [(r.s, r.n) for r in spark.sql(sql).collect()]
+        evs = _chunk_events("hybrid_hash_agg")
+        assert evs and evs[-1]["depth"] >= 2
+        assert metrics.join_stats()["recursive_repartitions"] >= 2
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+        spark.conf.unset("spark.tpu.join.hybrid.partitionsMax")
+    assert got == want
+
+
+@pytest.mark.parametrize("kind", ["transient", "hang", "corrupt", "oom"])
+def test_hybrid_spill_fault_matrix(spark, join_parquet, kind):
+    """join.spill x all four fault kinds, armed under a starved grant so
+    the spill seams actually run: transient/hang retry in place,
+    corrupt falls back one rung (grace recompute from source), oom
+    surfaces to the degradation ladder — bytes identical on every
+    surviving path."""
+    from spark_tpu import metrics
+
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    spark.conf.set("spark.tpu.join.hybrid.partitionsMax", 64)
+    spark.conf.set("spark.tpu.scheduler.hbmBudgetBytes", 64 * 1024)
+    spark.conf.set("spark.tpu.faultInjection.join.spill",
+                   f"nth:1:{kind}")
+    spark.conf.set("spark.tpu.faultInjection.hangSeconds", 0.05)
+    try:
+        metrics.reset()
+        metrics.reset_join()
+        metrics.reset_recovery()
+        if kind == "oom":
+            with pytest.raises(Exception) as ei:
+                spark.sql(sql).collect()
+            assert "RESOURCE_EXHAUSTED" in str(ei.value)
+            assert metrics.recovery_stats()["ladder_exhausted"] >= 1
+        else:
+            got = [(r.s, r.n) for r in spark.sql(sql).collect()]
+            js = metrics.join_stats()
+            if kind in ("transient", "hang"):
+                assert js["spill_retries"] >= 1
+                assert js["fallbacks"] == 0
+                assert _chunk_events("hybrid_hash_agg")
+            else:  # corrupt: not retryable -> grace recompute
+                assert js["fallbacks"] >= 1
+                assert _chunk_events("grace_hash_agg")
+            assert got == want
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+        spark.conf.unset("spark.tpu.join.hybrid.partitionsMax")
+        spark.conf.unset("spark.tpu.scheduler.hbmBudgetBytes")
+        spark.conf.unset("spark.tpu.faultInjection.join.spill")
+        spark.conf.unset("spark.tpu.faultInjection.hangSeconds")
+
+
+def test_hybrid_concurrent_tenant_budget_invariant(spark, join_parquet):
+    """execution grants + storage never exceed the unified budget while
+    the hybrid join runs against a concurrent tenant hammering
+    acquire/release on the same manager."""
+    import threading
+    import time
+
+    from spark_tpu import metrics
+
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]
+    mgr = spark.memory_manager
+    # drop batches cached by earlier tests: shrinking the budget below
+    # ALREADY-resident storage would manufacture a violation the
+    # manager never admitted (eviction only runs at admission time)
+    spark.memory_store.clear()
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    spark.conf.set("spark.tpu.join.hybrid.partitionsMax", 16)
+    spark.conf.set("spark.tpu.scheduler.hbmBudgetBytes", 192 * 1024)
+    stop = threading.Event()
+    violations = []
+
+    def check():
+        snap = mgr.snapshot()
+        if snap["in_use_bytes"] + snap["storage_bytes"] \
+                > snap["budget_bytes"]:
+            violations.append(snap)
+
+    def tenant():
+        while not stop.is_set():
+            c = mgr.acquire_execution(32 * 1024)
+            check()
+            time.sleep(0.001)
+            mgr.release_execution(c)
+
+    def sampler():
+        while not stop.is_set():
+            check()
+            time.sleep(0.0005)
+
+    threads = [threading.Thread(target=tenant, daemon=True),
+               threading.Thread(target=sampler, daemon=True)]
+    try:
+        metrics.reset()
+        metrics.reset_join()
+        for t in threads:
+            t.start()
+        got = [(r.s, r.n) for r in spark.sql(sql).collect()]
+        assert _chunk_events("hybrid_hash_agg")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+        spark.conf.unset("spark.tpu.join.hybrid.partitionsMax")
+        spark.conf.unset("spark.tpu.scheduler.hbmBudgetBytes")
+    assert not violations, violations[:3]
+    assert got == want
+
+
+def test_hybrid_zero_replans_where_ladder_replanned(spark, join_parquet):
+    """The acceptance bar: under a starved grant the hybrid join
+    completes as ONE planned pass (recovery replans == 0 even though
+    spills prove memory really was short); the old reactive path pays
+    >= 1 ladder replan for the same kind of pressure."""
+    from spark_tpu import metrics
+
+    sql = ("select sum(v * w) as s, count(*) as n "
+           "from oc_fact join oc_dim on k = dk")
+    want = [(r.s, r.n) for r in spark.sql(sql).collect()]
+
+    # NEW: planned single pass under a starved grant
+    spark.conf.set("spark.tpu.maxDeviceBatchBytes", 1024)
+    spark.conf.set("spark.tpu.chunkRows", 32_768)
+    spark.conf.set("spark.tpu.join.hybrid.partitionsMax", 64)
+    spark.conf.set("spark.tpu.scheduler.hbmBudgetBytes", 64 * 1024)
+    try:
+        metrics.reset()
+        metrics.reset_join()
+        metrics.reset_recovery()
+        got = [(r.s, r.n) for r in spark.sql(sql).collect()]
+        assert metrics.join_stats()["spill_writes"] >= 1
+        assert metrics.recovery_stats()["replans"] == 0
+    finally:
+        spark.conf.unset("spark.tpu.maxDeviceBatchBytes")
+        spark.conf.unset("spark.tpu.chunkRows")
+        spark.conf.unset("spark.tpu.join.hybrid.partitionsMax")
+        spark.conf.unset("spark.tpu.scheduler.hbmBudgetBytes")
+    assert got == want
+
+    # OLD: resident execution dies with OOM -> reactive ladder replans
+    spark.conf.set("spark.tpu.join.hybrid.enabled", False)
+    spark.conf.set("spark.tpu.faultInjection.execute.device",
+                   "nth:1:oom")
+    try:
+        metrics.reset_recovery()
+        got2 = [(r.s, r.n) for r in spark.sql(sql).collect()]
+        assert metrics.recovery_stats()["replans"] >= 1
+    finally:
+        spark.conf.unset("spark.tpu.join.hybrid.enabled")
+        spark.conf.unset("spark.tpu.faultInjection.execute.device")
+    assert got2 == want
