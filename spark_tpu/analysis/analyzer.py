@@ -34,6 +34,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu.plan import logical as L
 
@@ -45,7 +46,7 @@ from spark_tpu.analysis.diagnostics import (AnalysisReport, Diagnostic,
 #: declares it will actually attempt the transform
 _MERGE_CODES = ("PLAN-MERGE-FLOATSUM", "PLAN-MERGE-NONMERGEABLE")
 
-_RECENT_LOCK = threading.Lock()
+_RECENT_LOCK = locks.named_lock("analysis.recent")
 _RECENT_MAX = 64
 _RECENT: List[AnalysisReport] = []
 

@@ -65,6 +65,26 @@ never disagree):
                             a file fingerprint
 - ``PLAN-MVIEW-SHAPE``      not registrable: the aggregate is not at
                             the plan root
+
+Tree-wide concurrency analysis (``analysis/concurrency.py`` via
+``tools/lint_concurrency.py``; ``node`` is ``path:line`` instead of a
+plan node for these):
+
+- ``CONC-ORDER-CYCLE``      the static lock-acquisition graph contains
+                            an edge that inverts the registered lock
+                            hierarchy (spark_tpu/locks.py ranks) or a
+                            cycle among unranked locks — a lock-order
+                            deadlock waiting for the right interleaving
+- ``CONC-UNLOCKED-MUT``     module-level or ``self._``-prefixed state
+                            that is mutated under a lock somewhere is
+                            mutated with no lock held here (exempt
+                            table: ``[tool.lint-concurrency]``)
+- ``CONC-BLOCKING-HELD``    a blocking operation (queue put/get, HTTP,
+                            file IO, subprocess, sleep, device sync,
+                            thread join) runs while a lock is held
+- ``CONC-WAIT-NOLOOP``      ``Condition.wait`` outside a predicate
+                            loop: wakeups are permitted to be spurious,
+                            so every wait must re-check its predicate
 """
 
 from __future__ import annotations

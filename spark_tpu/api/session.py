@@ -14,6 +14,7 @@ import numpy as np
 
 import jax
 
+from spark_tpu import locks
 from spark_tpu import types as T
 from spark_tpu.api.dataframe import DataFrame
 from spark_tpu.conf import RuntimeConf
@@ -189,7 +190,7 @@ class CacheManager:
         self._store = store
         # entry = [plan, entry lock]
         self._entries: Dict[str, list] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("session.cache.registry")
         # set by SparkSession: the materialized-view manager; when a
         # cached key is a registered view, materialization delegates
         # to its freshness-checking refresh path (spark_tpu/mview/)
@@ -209,7 +210,7 @@ class CacheManager:
     def add(self, plan: L.LogicalPlan) -> None:
         with self._lock:
             self._entries.setdefault(
-                self._key(plan), [plan, threading.Lock()])
+                self._key(plan), [plan, locks.named_lock("session.cache.entry")])
         if self._mview is not None:
             self._mview.maybe_register(plan)
 
@@ -412,6 +413,9 @@ class SparkSession:
         _instrument_compile_cache()
         self.app_name = app_name
         self.conf = RuntimeConf(conf)
+        # runtime lock-order validation (spark.tpu.debug.lockOrder):
+        # flip the global flag before any service builds its locks
+        locks.configure(self.conf)
         self.catalog = Catalog(self)
         # unified storage/execution HBM accounting: the MemoryStore
         # (cached/auto-cached batches) and the scheduler's admission
@@ -582,14 +586,29 @@ class SparkSession:
                     df = df.withColumnRenamed(o, n)
         return df
 
-    def stop(self) -> None:
-        self._stopped = True
+    def _stop_services(self) -> None:
+        """Stop and join every background service/thread the session
+        owns (compile workers, scheduler worker pool, heartbeat
+        monitor, status UI). Split from ``stop()`` so tests can
+        quiesce the threads without tearing down the singleton."""
         svc = self.__dict__.pop("_compile_service", None)
         if svc is not None:
             svc.stop()
+        sched = getattr(self, "query_scheduler", None)
+        if sched is not None:
+            sched.stop()
+            self.query_scheduler = None
+        hb = getattr(self, "heartbeat_monitor", None)
+        if hb is not None:
+            hb.stop()
+            self.heartbeat_monitor = None
         if self._ui is not None:
             self._ui.stop()
             self._ui = None
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._stop_services()
         self.extensions.shutdown_plugins()
         SparkSession._reset()
 

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import faults, metrics
 from spark_tpu.compile.store import (ExecutableStore,
@@ -114,30 +115,35 @@ class PlanHistory:
     def __init__(self, path: str, max_entries: int = 512):
         self.path = path
         self.max_entries = max(1, int(max_entries))
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("compile.history")
         #: fp -> [count, sql-or-None]
         self._counts: Dict[str, List] = {}
         self._lines = 0
         self._load()
 
     def _load(self) -> None:
+        # read outside the lock, apply under it: the counters are
+        # lock-guarded state everywhere else, and holding the lock
+        # across file IO is exactly what the concurrency linter bans
         try:
             with open(self.path) as f:
-                for line in f:
-                    self._lines += 1
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    fp = rec.get("fp")
-                    if not fp:
-                        continue
-                    ent = self._counts.setdefault(fp, [0, None])
-                    ent[0] += int(rec.get("n", 1))
-                    if rec.get("sql"):
-                        ent[1] = rec["sql"]
+                raw = f.readlines()
         except OSError:
-            pass
+            return
+        with self._lock:
+            for line in raw:
+                self._lines += 1
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                fp = rec.get("fp")
+                if not fp:
+                    continue
+                ent = self._counts.setdefault(fp, [0, None])
+                ent[0] += int(rec.get("n", 1))
+                if rec.get("sql"):
+                    ent[1] = rec["sql"]
 
     def note(self, fp: str, sql: Optional[str] = None) -> None:
         with self._lock:
@@ -228,9 +234,9 @@ class CompileService:
         #: routing-key -> {"status": new|compiling|ready|failed,
         #:                 "chunk_serves": int, "swapped": bool, ...}
         self._plans: Dict[Any, dict] = {}
-        self._plans_lock = threading.Lock()
+        self._plans_lock = locks.named_lock("compile.plans")
         self._jobs: List[threading.Thread] = []
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = locks.named_lock("compile.jobs")
         self._prewarm_report: Optional[dict] = None
         self._stopped = False
 
@@ -282,7 +288,7 @@ class CompileService:
 
         metrics.note_exec_store("misses")
         state: dict = {}
-        state_lock = threading.Lock()
+        state_lock = locks.named_lock("compile.stage")
         serialize = bool(self._conf().get(CF.COMPILE_STORE_SERIALIZE))
 
         def miss_call(args):
@@ -496,7 +502,7 @@ class CompileService:
         t0 = time.monotonic()
         report: dict = {"replayed": [], "skipped": [], "errors": [],
                         "budget_s": budget_s}
-        report_lock = threading.Lock()
+        report_lock = locks.named_lock("compile.prewarm")
         metrics.record("compile", phase="prewarm_start",
                        candidates=len(entries), workers=workers)
 
@@ -532,7 +538,7 @@ class CompileService:
                 replay_one(fp, sql, count)
         else:
             idx = [0]
-            idx_lock = threading.Lock()
+            idx_lock = locks.named_lock("compile.prewarm")
 
             def worker():
                 while True:

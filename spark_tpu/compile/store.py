@@ -37,6 +37,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 
+from spark_tpu import locks
 from spark_tpu import metrics
 
 _ENTRY_SUFFIX = ".exe"
@@ -45,7 +46,7 @@ _ENTRY_SUFFIX = ".exe"
 #: second Session over the same store dir in one process skips even the
 #: disk read/deserialize. Tests clear it to force the disk path.
 _LOADED: dict = {}
-_LOADED_LOCK = threading.Lock()
+_LOADED_LOCK = locks.named_lock("compile.loaded")
 
 
 # ---- stable plan fingerprint ------------------------------------------------
@@ -54,7 +55,7 @@ _LOADED_LOCK = threading.Lock()
 #: TPC-H comment columns carry multi-million-entry dictionaries and the
 #: digest must not be recomputed per lookup
 _DICT_FP: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-_DICT_FP_LOCK = threading.Lock()
+_DICT_FP_LOCK = locks.named_lock("compile.dict_fp")
 
 
 def _dict_digest(schema) -> str:
@@ -206,7 +207,7 @@ class ExecutableStore:
         self.entries_dir = os.path.join(self.root, "entries")
         self.max_bytes = int(max_bytes)
         os.makedirs(self.entries_dir, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("compile.store")
 
     # -- paths
 

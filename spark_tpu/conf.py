@@ -412,6 +412,16 @@ ANALYSIS_DIVERGENCE_FACTOR = register(
     "gets a PLAN-EST-DIVERGE diagnostic — the cost model is lying to "
     "admission control for this plan shape.", float)
 
+DEBUG_LOCK_ORDER = register(
+    "spark.tpu.debug.lockOrder", False,
+    "Runtime cross-check of the static lock hierarchy "
+    "(spark_tpu/locks.py): when true, every named lock records the "
+    "per-thread held-stack on acquire and locks.order_report() exposes "
+    "the observed acquisition edges plus any rank inversions or cycles "
+    "— the empirical validation of tools/lint_concurrency.py's graph. "
+    "Off by default (a global-flag check per acquire either way).",
+    bool)
+
 ANALYSIS_ERROR_CODES = register(
     "spark.tpu.analysis.errorCodes", "",
     "Comma-separated diagnostic codes escalated to error level at the "

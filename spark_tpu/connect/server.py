@@ -283,7 +283,8 @@ class ConnectServer:
 
     def start(self) -> "ConnectServer":
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever,
+            name="spark-tpu-connect", daemon=True)
         self._thread.start()
         # AOT pre-warm: replay the served-plan history on a background
         # worker so the plan space is traced/compiled (or loaded from
@@ -302,6 +303,9 @@ class ConnectServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
         self.scheduler.stop()
         if getattr(self.session, "query_scheduler", None) \
                 is self.scheduler:

@@ -103,6 +103,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import metrics
 
@@ -222,7 +223,7 @@ class _PointState:
             else None
 
 
-_LOCK = threading.Lock()
+_LOCK = locks.named_lock("faults.registry")
 
 
 def _resolve_conf(conf):

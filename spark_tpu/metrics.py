@@ -36,8 +36,10 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-_LOCK = threading.Lock()
-_IO_LOCK = threading.Lock()
+from spark_tpu import locks
+
+_LOCK = locks.named_lock("metrics.registry")
+_IO_LOCK = locks.named_lock("metrics.io")
 _EVENTS: deque = deque(maxlen=4096)
 #: (first event counter, trace_id-or-None) per started query
 _QUERY_MARKS: deque = deque(maxlen=64)
@@ -355,9 +357,9 @@ def reset_exec_store() -> None:
 #: rejections (the only case a client still sees a 429), and replica
 #: connection failures. Shown in tracing.serve_profile and
 #: /api/v1/serve.
-_SERVE = {"hits": 0, "misses": 0, "waits": 0, "dispatches": 0,
-          "sheds": 0, "redispatches": 0, "rejected": 0,
-          "replica_failures": 0}
+_SERVE = {"hits": 0, "misses": 0, "waits": 0, "wait_timeouts": 0,
+          "dispatches": 0, "sheds": 0, "redispatches": 0,
+          "rejected": 0, "replica_failures": 0}
 
 
 def note_serve(kind: str, n: int = 1) -> None:
@@ -513,7 +515,7 @@ class PipelineStats:
 
     def __init__(self):
         self._t0 = time.perf_counter()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("metrics.pipeline_stats")
         self._ms: Dict[str, float] = {}
         self._active = {"producer": 0, "consumer": 0}
         self._both_since: Optional[float] = None

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional
 
 import pyarrow as pa
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import faults, metrics, recovery
 from spark_tpu.io.fingerprint import classify_delta, source_fingerprint
@@ -59,7 +60,7 @@ class ViewManager:
         self._session = session
         self._views: Dict[Any, MaterializedView] = {}
         self._by_stream: Dict[str, List[MaterializedView]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("mview.manager")
 
     # -- conf ---------------------------------------------------------------
 

@@ -23,11 +23,13 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from spark_tpu import locks
+
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "strkernels.cpp")
 _SO = os.path.join(_DIR, "_strkernels.so")
 
-_lock = threading.Lock()
+_lock = locks.named_lock("native.registry")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

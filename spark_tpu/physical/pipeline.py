@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import faults, metrics, trace
 from spark_tpu.metrics import PipelineStats
@@ -93,7 +94,7 @@ class ChunkPipeline:
         self._trace_ctx = metrics.trace_context()
         if self._depth >= 1:
             self._queue: queue.Queue = queue.Queue(maxsize=self._depth)
-            self._cond = threading.Condition()
+            self._cond = locks.named_condition("pipeline.cond")
             self._inflight_bytes = 0
             self._inflight_chunks = 0
             self._stop = False
@@ -182,7 +183,10 @@ class ChunkPipeline:
                     while (not self._stop
                            and self._inflight_chunks > 0
                            and self._inflight_bytes >= self._budget):
-                        self._cond.wait(0.05)
+                        # notify-driven: the consumer notifies on every
+                        # chunk release and close(); the timeout is a
+                        # liveness backstop only
+                        self._cond.wait(0.5)
                     if self._stop:
                         return
                 waited = (time.perf_counter() - t0) * 1e3

@@ -29,6 +29,7 @@ import uuid
 from contextlib import nullcontext
 from typing import Callable, Iterator, Optional
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import faults, metrics, trace
 
@@ -445,7 +446,7 @@ class HeartbeatMonitor:
 
 
 _CKPT_COUNTER = [0]
-_CKPT_LOCK = threading.Lock()
+_CKPT_LOCK = locks.named_lock("recovery.checkpoint")
 
 
 def checkpoint_dataframe(df, eager: bool = True):

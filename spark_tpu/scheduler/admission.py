@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import threading
 
+from spark_tpu import locks
+
 from spark_tpu import conf as CF
 
 #: floor on any footprint estimate — below this the estimate noise
@@ -35,7 +37,7 @@ MIN_ESTIMATE_BYTES = 64 * 1024
 #: the static row-count estimate). Bounded LRU under a lock —
 #: structural keys pin source objects by id, so unbounded growth would
 #: also pin dead batches.
-_MEASURED_LOCK = threading.Lock()
+_MEASURED_LOCK = locks.named_lock("admission.measured")
 _MEASURED_MAX_ENTRIES = 512
 _MEASURED: "dict" = {}
 

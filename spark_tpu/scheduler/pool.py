@@ -18,6 +18,7 @@ import threading
 from collections import deque
 from typing import Dict
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 
 
@@ -89,7 +90,7 @@ class PoolRegistry:
 
     def __init__(self, conf):
         self._conf = conf
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("scheduler.pools")
         self._pools = build_pools(conf)
         self.default_name = str(conf.get(CF.SCHEDULER_DEFAULT_POOL))
 

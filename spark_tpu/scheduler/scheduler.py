@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import faults, metrics
 from spark_tpu.scheduler.admission import (AdmissionController,
@@ -175,7 +176,14 @@ class QueryScheduler:
         self.admission = AdmissionController(
             int(conf.get(CF.SCHEDULER_HBM_BUDGET)),
             manager=getattr(session, "memory_manager", None))
-        self._cond = threading.Condition()
+        self._cond = locks.named_condition("scheduler.cond")
+        # grant releases by OTHER tenants of the shared manager (hybrid
+        # join spill grants, direct manager users) must wake the gate
+        # too, not just this scheduler's own _release. The manager fires
+        # listeners after dropping its lock, so the callback's
+        # cond-acquire creates no storage.unified -> scheduler.cond
+        # hierarchy edge.
+        self.admission.manager.add_release_listener(self._wake_gate)
         self._seq = 0
         self._queued = 0
         self._gate: List[QueryTicket] = []  # waiting for device admission
@@ -348,7 +356,9 @@ class QueryScheduler:
                     t = self._pick_locked()
                     if t is not None:
                         break
-                    self._cond.wait(0.1)
+                    # notify-driven: submit/cancel/stop/finish all
+                    # notify; the timeout is only a liveness backstop
+                    self._cond.wait(0.5)
                 if self._stopped:
                     return
             self._execute(t)
@@ -468,9 +478,26 @@ class QueryScheduler:
                         self.pools.get(t.pool).device_running += 1
                         t._gate_t0 = time.perf_counter()
                         return
-                    self._cond.wait(0.05)
+                    # notify-driven: grant releases (scheduler's own
+                    # _release and, via the manager's release listener,
+                    # any other tenant's), cancel and stop all notify.
+                    # The timeout is a deadline/liveness backstop only.
+                    timeout = 0.5
+                    if t.deadline is not None:
+                        timeout = min(
+                            timeout, max(0.01, t.deadline - time.time()))
+                    self._cond.wait(timeout)
             finally:
                 self._gate.remove(t)
+                # the policy-best waiter changed: wake the others so
+                # the new best re-checks its fit without polling
+                self._cond.notify_all()
+
+    def _wake_gate(self) -> None:
+        """Release-listener target: an execution grant somewhere on the
+        shared memory manager was released, so a gate waiter may fit."""
+        with self._cond:
+            self._cond.notify_all()
 
     def _release(self, t: QueryTicket) -> None:
         if t._granted:

@@ -38,6 +38,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import faults, metrics, trace
 
@@ -106,7 +107,7 @@ class Federation:
             raise ValueError("federation needs at least one replica")
         self.timeout = float(timeout)
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("serve.federation")
 
     # -- health ---------------------------------------------------------------
 

@@ -33,15 +33,31 @@ from typing import Any, Callable, Optional, Tuple
 
 import pyarrow as pa
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import metrics
 from spark_tpu.storage.lru import LruDict
 
 #: follower wait bound per round: the owner always sets the flight
 #: event in a ``finally``, so this only guards against an owner thread
-#: killed by interpreter shutdown; on expiry the follower loops and
-#: may become the owner itself.
+#: killed by interpreter shutdown or wedged on the device; on expiry a
+#: typed FlightWaitTimeout is recorded and the follower falls through
+#: to its own execution instead of waiting forever.
 _FLIGHT_WAIT_S = 600.0
+
+
+class FlightWaitTimeout(RuntimeError):
+    """A single-flight follower waited the full bound without the
+    owner publishing a result or an error. Surfaced in the event log
+    (serve_cache phase=wait_timeout) before the follower executes the
+    query itself."""
+
+    def __init__(self, key_digest_: str, waited_s: float):
+        super().__init__(
+            f"single-flight wait for {key_digest_} timed out after "
+            f"{waited_s:g}s; executing independently")
+        self.key_digest = key_digest_
+        self.waited_s = float(waited_s)
 
 
 def scan_fingerprints(plan) -> Tuple[Any, ...]:
@@ -111,7 +127,7 @@ class ResultCache:
             weigher=len,
             conf=conf)
         self._flights: dict = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("serve.result_cache")
 
     def enabled(self) -> bool:
         try:
@@ -158,7 +174,8 @@ class ResultCache:
                     fl.blob = blob
                     self.put(key, blob)
                 except BaseException as e:
-                    fl.error = e
+                    if self._herd_error(e):
+                        fl.error = e
                     raise
                 finally:
                     fl.event.set()
@@ -174,7 +191,20 @@ class ResultCache:
                 return blob, "miss"
             # follower: block on the owner's flight
             metrics.note_serve("waits")
-            fl.event.wait(timeout=_FLIGHT_WAIT_S)
+            t0 = time.perf_counter()
+            if not fl.event.wait(timeout=_FLIGHT_WAIT_S):
+                # the owner exceeded the flight bound without
+                # publishing a result or an error: surface the typed
+                # timeout and execute independently rather than wait
+                # on a wedged owner forever
+                tmo = FlightWaitTimeout(kd, time.perf_counter() - t0)
+                metrics.note_serve("wait_timeouts")
+                metrics.record("serve_cache", phase="wait_timeout",
+                               key=kd, error=repr(tmo))
+                tbl = execute()
+                blob = table_to_ipc(tbl)
+                self.put(key, blob)
+                return blob, "timeout"
             if fl.error is not None:
                 # the owner's failure is this caller's failure too —
                 # a SchedulerQueueFull here propagates so the router
@@ -184,8 +214,22 @@ class ResultCache:
                 metrics.record("serve_cache", phase="wait", key=kd,
                                bytes=len(fl.blob))
                 return fl.blob, "wait"
-            # owner vanished without result or error (interpreter
-            # teardown): loop and take ownership
+            # owner finished without result or herd-relevant error
+            # (owner-local cancellation, interpreter teardown): loop
+            # and take ownership
+
+    @staticmethod
+    def _herd_error(e: BaseException) -> bool:
+        """Owner failures that apply to every follower of the flight.
+        Owner-LOCAL outcomes must not fan out: the owner's
+        cancellation/deadline belongs to its own caller, not the herd,
+        and BaseExceptions (KeyboardInterrupt, SystemExit) are
+        interpreter-level. Followers of a non-herd failure find neither
+        blob nor error and loop to take ownership themselves."""
+        from spark_tpu.scheduler.scheduler import QueryCancelled
+
+        return (isinstance(e, Exception)
+                and not isinstance(e, QueryCancelled))
 
     def put(self, key, blob: bytes) -> None:
         """Insert one serialized result; an oversized single result is

@@ -164,6 +164,9 @@ class FederationRouter:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     @property
     def url(self) -> str:

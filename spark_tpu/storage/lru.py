@@ -22,6 +22,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from spark_tpu import locks
 from spark_tpu import metrics
 
 
@@ -46,7 +47,7 @@ class LruDict:
         self._weigher = weigher
         self._weights: "OrderedDict[Any, int]" = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("storage.lru")
         self.evictions = 0
 
     def _conf_value(self, entry, fallback):

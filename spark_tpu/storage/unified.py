@@ -34,6 +34,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
+from spark_tpu import locks
 from spark_tpu import conf as CF
 
 
@@ -56,7 +57,7 @@ class UnifiedMemoryManager:
         self._max_storage = max_storage_bytes
         #: one lock for BOTH sides; the store shares it so an eviction
         #: decision and the byte accounting it is based on are atomic
-        self.lock = threading.RLock()
+        self.lock = locks.named_rlock("storage.unified")
         self._execution = 0
         self._admitted = 0
         self._store = None  # MemoryStore registers itself
@@ -72,6 +73,14 @@ class UnifiedMemoryManager:
         self.zero_grants = 0       # non-zero request granted 0 bytes
         self.grows = 0             # mid-execution try_grow successes
         self.grow_denials = 0      # try_grow found no free span
+        #: callbacks fired AFTER release_execution drops the lock —
+        #: the scheduler's gate condition registers here so grant
+        #: releases by other tenants (hybrid join spill grants, direct
+        #: manager users) wake its waiters without polling. Firing
+        #: under self.lock would nest storage.unified -> scheduler.cond
+        #: against the hierarchy; that inversion is exactly what the
+        #: concurrency linter rejects.
+        self._release_listeners = []
 
     # -- live-conf properties ------------------------------------------------
 
@@ -177,10 +186,23 @@ class UnifiedMemoryManager:
                 self.grow_denials += 1
             return got
 
+    def add_release_listener(self, callback) -> None:
+        """Register a callback invoked (outside the lock) every time an
+        execution grant is released — i.e. whenever a blocked admission
+        might now fit."""
+        with self.lock:
+            self._release_listeners.append(callback)
+
     def release_execution(self, charge: int) -> None:
         with self.lock:
             self._execution = max(0, self._execution - int(charge))
             self._admitted = max(0, self._admitted - 1)
+            listeners = list(self._release_listeners)
+        for cb in listeners:
+            try:
+                cb()
+            except Exception:
+                pass
 
     def _storage_freeable_locked(self) -> int:
         """Unpinned storage bytes execution could reclaim without

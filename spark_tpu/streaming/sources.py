@@ -15,6 +15,7 @@ from typing import List, Optional
 
 import pyarrow as pa
 
+from spark_tpu import locks
 from spark_tpu.types import Schema
 
 _ids = itertools.count()
@@ -30,7 +31,7 @@ class MemoryStream:
         else:
             self._example = schema_or_example
         self._rows: List[pa.Table] = []
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("streaming.source")
         self.name = f"memory-{next(_ids)}"
 
     # -- producer side --------------------------------------------------------

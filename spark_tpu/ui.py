@@ -350,6 +350,7 @@ class StatusServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._thread.join(timeout=5.0)
 
 
 def maybe_start(session) -> Optional[StatusServer]:

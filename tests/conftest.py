@@ -86,7 +86,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "analysis: static plan analysis — shape/dtype/capacity oracle, "
-        "recompilation hazards, transform legality, invariant linter")
+        "recompilation hazards, transform legality, invariant + "
+        "concurrency linters")
     config.addinivalue_line(
         "markers",
         "serve: scale-out serving tier (spark_tpu/serve/) — federation "

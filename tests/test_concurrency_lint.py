@@ -1,0 +1,208 @@
+"""Tree-wide concurrency analyzer (spark_tpu/analysis/concurrency.py)
++ its CLI (tools/lint_concurrency.py).
+
+Coverage contract (mirrors tests/test_analysis.py for the invariant
+linter):
+
+- the linter is CLEAN on this tree (zero findings with the checked-in
+  [tool.lint-concurrency] config),
+- each CONC-* rule fires on a seeded violation with exactly its own
+  code — rank inversion, unranked cycle, unlocked mutation of shared
+  state, blocking call under a held lock, Condition.wait outside a
+  predicate loop,
+- the exemption table cannot rot: blank justifications and stale keys
+  are themselves findings,
+- the CLI exits 0 on the tree, alongside lint_invariants (both run in
+  tier-1 through this file).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from spark_tpu import locks
+from spark_tpu.analysis import concurrency
+
+pytestmark = pytest.mark.analysis
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+import lint_concurrency  # noqa: E402
+
+
+def _codes(src, **kw):
+    findings = concurrency.analyze_sources(
+        {"x.py": textwrap.dedent(src)}, **kw)
+    return [d.code for d in findings], findings
+
+
+# ---- clean on this tree -----------------------------------------------------
+
+
+def test_conc_lint_clean_on_tree():
+    findings = lint_concurrency.run_lint()
+    assert findings == [], "\n".join(d.format() for d in findings)
+
+
+def test_lock_registry_sane():
+    assert locks.LOCK_RANKS, "registry must not be empty"
+    for name, rank in locks.LOCK_RANKS.items():
+        assert isinstance(name, str) and name
+        assert isinstance(rank, int) and rank > 0
+    # every alias in the checked-in config points at a registered name
+    cfg = lint_concurrency._load_config()
+    for key, target in cfg["aliases"].items():
+        assert target in locks.LOCK_RANKS, \
+            f"alias {key!r} -> unregistered lock {target!r}"
+
+
+# ---- seeded violations: each rule fires exactly its code --------------------
+
+
+def test_seeded_rank_inversion_fires():
+    codes, findings = _codes("""\
+        from spark_tpu import locks
+
+        class Store:
+            def __init__(self):
+                self._mgr_lock = locks.named_rlock("storage.unified")
+                self._reg_lock = locks.named_lock(
+                    "session.cache.registry")
+
+            def bad(self):
+                with self._mgr_lock:
+                    with self._reg_lock:
+                        return 1
+        """)
+    assert codes == ["CONC-ORDER-CYCLE"], findings
+    assert "inverts" in findings[0].message
+
+
+def test_seeded_unranked_cycle_fires():
+    codes, findings = _codes("""\
+        import threading
+
+        _A_LOCK = threading.Lock()
+        _B_LOCK = threading.Lock()
+
+        def f1():
+            with _A_LOCK:
+                with _B_LOCK:
+                    pass
+
+        def f2():
+            with _B_LOCK:
+                with _A_LOCK:
+                    pass
+        """)
+    assert codes == ["CONC-ORDER-CYCLE"], findings
+    assert "cycle" in findings[0].message
+
+
+def test_seeded_unlocked_mutation_fires():
+    codes, findings = _codes("""\
+        import threading
+
+        _LOCK = threading.Lock()
+        _TABLE = {}
+
+        def locked_put(k, v):
+            with _LOCK:
+                _TABLE[k] = v
+
+        def bare_drop(k):
+            _TABLE.pop(k, None)
+        """)
+    assert codes == ["CONC-UNLOCKED-MUT"], findings
+    assert "bare_drop" in findings[0].message
+
+
+def test_seeded_blocking_under_lock_fires():
+    codes, findings = _codes("""\
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+
+        def slow():
+            with _LOCK:
+                time.sleep(0.1)
+        """)
+    assert codes == ["CONC-BLOCKING-HELD"], findings
+    assert "time.sleep()" in findings[0].message
+
+
+def test_seeded_bare_wait_fires_and_looped_wait_passes():
+    codes, findings = _codes("""\
+        import threading
+
+        _COND = threading.Condition()
+
+        def bad_wait():
+            with _COND:
+                _COND.wait()
+
+        def good_wait(pred):
+            with _COND:
+                while not pred():
+                    _COND.wait()
+        """)
+    assert codes == ["CONC-WAIT-NOLOOP"], findings
+    assert findings[0].node == "x.py:7"
+
+
+def test_exemption_suppresses_blocking_finding():
+    src = """\
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+
+        def slow():
+            with _LOCK:
+                time.sleep(0.1)
+        """
+    codes, _ = _codes(src, exempt_blocking={"x.py::slow": "seeded"})
+    assert codes == []
+
+
+# ---- exemption-table hygiene ------------------------------------------------
+
+
+def _mini_config(**over):
+    cfg = {"paths": ["spark_tpu/analysis"], "exclude": [],
+           "aliases": {}, "exempt_unlocked": {}, "exempt_blocking": {}}
+    cfg.update(over)
+    return cfg
+
+
+def test_blank_justification_is_a_finding():
+    cfg = _mini_config(exempt_blocking={
+        "spark_tpu/analysis/concurrency.py::whatever": "   "})
+    codes = [d.code for d in lint_concurrency.run_lint(config=cfg)]
+    assert codes == ["CONC-EXEMPT-UNJUSTIFIED"]
+
+
+def test_stale_exemption_key_is_a_finding():
+    cfg = _mini_config(exempt_unlocked={
+        "spark_tpu/analysis/deleted_module.py::_X": "was real once"})
+    codes = [d.code for d in lint_concurrency.run_lint(config=cfg)]
+    assert codes == ["CONC-EXEMPT-STALE"]
+
+
+# ---- CLI: both linters run in tier-1 and exit 0 -----------------------------
+
+
+@pytest.mark.parametrize("tool", ["lint_concurrency.py",
+                                  "lint_invariants.py"])
+def test_lint_cli_exits_zero(tool):
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools", tool)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout

@@ -1,0 +1,312 @@
+"""Runtime cross-check of the static lock hierarchy
+(spark_tpu/locks.py) + the concurrency fixes it guards.
+
+- the lock-order validator (spark.tpu.debug.lockOrder) detects a
+  seeded rank inversion and a seeded cycle,
+- a real workload (warm TPC-H q1, cached DataFrames, scheduler
+  round-trips) runs with the validator ON and records ZERO violations
+  and ZERO cycles — the runtime graph agrees with the hierarchy the
+  static linter enforces,
+- the validator's per-acquire cost is micro (design target: <3%
+  overhead on a warm q1; asserted here as an absolute per-pair bound
+  plus a loose warm-query ratio so CI stays deterministic),
+- single-flight followers in the serve result cache time out on a
+  wedged owner (typed FlightWaitTimeout in the event log) and fall
+  through to their own execution,
+- an owner's QueryCancelled is owner-local: followers re-execute
+  instead of inheriting the cancellation,
+- every session-owned daemon thread quiesces on stop
+  (test_threads_quiesce).
+"""
+
+import threading
+import time
+
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_tpu import locks, metrics
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.scheduler import QueryScheduler
+from spark_tpu.scheduler.scheduler import QueryCancelled
+from spark_tpu.serve import result_cache as rc
+from spark_tpu.tpch.gen import generate_tables, register_views
+from spark_tpu.tpch.queries import QUERIES
+
+pytestmark = pytest.mark.timeout(180)
+
+
+@pytest.fixture()
+def validator():
+    """Validation ON with a clean slate; always restored OFF."""
+    locks.reset_observations()
+    locks.set_validation(True)
+    try:
+        yield
+    finally:
+        locks.set_validation(False)
+        locks.reset_observations()
+
+
+@pytest.fixture(scope="module")
+def tpch(spark):
+    tables = generate_tables(0.01, seed=7)
+    register_views(spark, tables)
+    return spark
+
+
+# ---- seeded runtime violations ----------------------------------------------
+
+
+def test_validator_detects_seeded_inversion(validator):
+    outer = locks.named_rlock("storage.unified")      # rank 400
+    inner = locks.named_lock("session.cache.registry")  # rank 100
+    with outer:
+        with inner:
+            pass
+    rep = locks.order_report()
+    assert rep["enabled"]
+    assert ["storage.unified", "session.cache.registry"] in \
+        [v["edge"] for v in rep["violations"]]
+    v = next(v for v in rep["violations"]
+             if v["edge"] == ["storage.unified",
+                              "session.cache.registry"])
+    assert v["kind"] == "rank-inversion" and v["ranks"] == [400, 100]
+
+
+def test_validator_detects_seeded_cycle(validator):
+    # register_lock is idempotent for an unchanged rank, so the test
+    # can re-run in one process; a->b is rank-legal, b->a closes the
+    # cycle (and is itself an inversion — ranks are a total order, so
+    # every cycle contains one)
+    locks.register_lock("test.cycle.a", 10_001)
+    locks.register_lock("test.cycle.b", 10_002)
+    a = locks.named_lock("test.cycle.a")
+    b = locks.named_lock("test.cycle.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = locks.order_report()
+    assert rep["cycles"], rep
+    assert set(rep["cycles"][0]) >= {"test.cycle.a", "test.cycle.b"}
+    assert ["test.cycle.b", "test.cycle.a"] in \
+        [v["edge"] for v in rep["violations"]]
+
+
+def test_validator_same_name_reentry_is_legal(validator):
+    # sibling instances under one registry name (per-entry locks) and
+    # RLock re-entry must not register edges or violations
+    l1 = locks.named_lock("session.cache.entry")
+    l2 = locks.named_lock("session.cache.entry")
+    with l1:
+        with l2:
+            pass
+    r = locks.named_rlock("storage.unified")
+    with r:
+        with r:
+            pass
+    rep = locks.order_report()
+    assert rep["violations"] == [] and rep["edges"] == []
+
+
+# ---- real workload: zero violations with the validator on -------------------
+
+
+def test_workload_zero_violations(tpch, validator):
+    spark = tpch
+    # warm q1: scheduler, cache and storage locks all see traffic
+    spark.sql(QUERIES[1]).collect()
+    df = spark.createDataFrame(
+        pd.DataFrame({"k": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]}))
+    df.groupBy("k").count().collect()
+    cached = df.cache()
+    cached.collect()
+    cached.collect()
+    sched = QueryScheduler(conf=RuntimeConf({}))
+    try:
+        tasks = [sched.submit(lambda tk, i=i: i) for i in range(4)]
+        assert [t.result(timeout=30) for t in tasks] == [0, 1, 2, 3]
+    finally:
+        sched.stop()
+    rep = locks.order_report()
+    assert rep["violations"] == [], rep["violations"]
+    assert rep["cycles"] == [], rep["cycles"]
+    # the run actually nested locks (scheduler cond over metrics et
+    # al.) — an empty edge set would mean the proxies were bypassed
+    assert rep["edges"], "validator observed no lock nesting at all"
+
+
+def test_validator_per_acquire_overhead_micro():
+    lk = locks.named_lock("metrics.registry")
+    n = 20000
+
+    def bench():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with lk:
+                pass
+        return time.perf_counter() - t0
+
+    locks.set_validation(False)
+    off = min(bench() for _ in range(3))
+    locks.reset_observations()
+    locks.set_validation(True)
+    try:
+        on = min(bench() for _ in range(3))
+    finally:
+        locks.set_validation(False)
+        locks.reset_observations()
+    # the <3% warm-q1 budget translates to single-digit microseconds
+    # per acquire/release pair; 50us absolute keeps CI deterministic
+    assert (on - off) / n < 50e-6, f"on={on:.4f}s off={off:.4f}s"
+
+
+def test_validator_overhead_warm_q1(tpch):
+    spark = tpch
+    run = lambda: spark.sql(QUERIES[1]).collect()  # noqa: E731
+    run()  # compile + trace warm-up
+    locks.set_validation(False)
+    times_off = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run()
+        times_off.append(time.perf_counter() - t0)
+    locks.reset_observations()
+    locks.set_validation(True)
+    try:
+        times_on = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run()
+            times_on.append(time.perf_counter() - t0)
+        rep = locks.order_report()
+    finally:
+        locks.set_validation(False)
+        locks.reset_observations()
+    assert rep["violations"] == [] and rep["cycles"] == []
+    # design target is <3%; the micro test above pins the mechanism,
+    # this one only guards against a gross regression (best-of-2 with
+    # generous absolute slack so CI noise cannot flake it)
+    assert min(times_on) <= min(times_off) * 1.30 + 0.05, \
+        f"on={min(times_on):.4f}s off={min(times_off):.4f}s"
+
+
+# ---- serve result cache: bounded follower wait ------------------------------
+
+
+def _cache(**overrides):
+    base = {"spark.tpu.serve.resultCache.enabled": True}
+    base.update(overrides)
+    return rc.ResultCache(RuntimeConf(base))
+
+
+def test_flight_wait_timeout_falls_through(monkeypatch):
+    monkeypatch.setattr(rc, "_FLIGHT_WAIT_S", 0.2)
+    cache = _cache()
+    tbl = pa.table({"x": [1, 2, 3]})
+    started, release = threading.Event(), threading.Event()
+
+    def wedged_owner():
+        started.set()
+        release.wait(timeout=30)
+        return tbl
+
+    before = metrics.serve_stats().get("wait_timeouts", 0)
+    owner_res = {}
+    th = threading.Thread(
+        target=lambda: owner_res.update(
+            r=cache.get_or_execute("q", wedged_owner)),
+        daemon=True)
+    th.start()
+    assert started.wait(timeout=10)
+    # follower must NOT wait forever on the wedged owner: typed
+    # timeout recorded, then it executes independently
+    blob, status = cache.get_or_execute("q", lambda: tbl)
+    assert status == "timeout"
+    assert pa.ipc.open_stream(blob).read_all().equals(tbl)
+    after = metrics.serve_stats().get("wait_timeouts", 0)
+    assert after == before + 1
+    release.set()
+    th.join(timeout=30)
+    assert owner_res["r"][1] == "miss"
+
+
+def test_flight_wait_timeout_is_typed():
+    e = rc.FlightWaitTimeout("abcd1234", 0.25)
+    assert isinstance(e, RuntimeError)
+    assert e.key_digest == "abcd1234" and e.waited_s == 0.25
+    assert "abcd1234" in str(e)
+
+
+def test_owner_cancellation_not_inherited_by_followers():
+    cache = _cache()
+    tbl = pa.table({"x": [7]})
+    started, proceed = threading.Event(), threading.Event()
+
+    def cancelled_owner():
+        started.set()
+        proceed.wait(timeout=30)
+        raise QueryCancelled("owner-local deadline")
+
+    owner_res = {}
+
+    def owner():
+        try:
+            cache.get_or_execute("qc", cancelled_owner)
+        except QueryCancelled as e:
+            owner_res["e"] = e
+
+    to = threading.Thread(target=owner, daemon=True)
+    to.start()
+    assert started.wait(timeout=10)
+    follower_res = {}
+    tf = threading.Thread(
+        target=lambda: follower_res.update(
+            r=cache.get_or_execute("qc", lambda: tbl)),
+        daemon=True)
+    tf.start()
+    time.sleep(0.1)  # let the follower park on the flight event
+    proceed.set()
+    to.join(timeout=30)
+    tf.join(timeout=30)
+    # the owner sees ITS cancellation; the follower does not inherit
+    # it — it loops, takes ownership and executes
+    assert isinstance(owner_res["e"], QueryCancelled)
+    assert follower_res["r"][1] == "miss"
+    assert pa.ipc.open_stream(follower_res["r"][0]).read_all() \
+        .equals(tbl)
+
+
+# ---- every session daemon thread quiesces on stop ---------------------------
+
+
+def test_threads_quiesce(spark):
+    from spark_tpu.connect.server import ConnectServer
+
+    srv = ConnectServer(spark, port=0).start()
+    _ = spark.compile_service  # materialize the lazy service
+    sched = spark.query_scheduler
+    assert sched is not None
+    t = sched.submit(lambda tk: 41 + 1)
+    assert t.result(timeout=30) == 42
+    alive = {th.name for th in threading.enumerate()}
+    assert any(n.startswith("spark-tpu-") for n in alive), alive
+    srv.stop()
+    # _stop_services (used by SparkSession.stop) joins everything the
+    # session owns without tearing down the singleton the shared
+    # `spark` fixture holds; lazy services re-materialize on demand
+    spark._stop_services()
+    prefixes = ("spark-tpu-", "chunk-pipeline")
+    deadline = time.time() + 15
+    leftover = ["unchecked"]
+    while time.time() < deadline:
+        leftover = [th.name for th in threading.enumerate()
+                    if th.name.startswith(prefixes)]
+        if not leftover:
+            break
+        time.sleep(0.05)
+    assert leftover == [], f"threads survived stop: {leftover}"

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Tree-wide concurrency linter: static lock-order / shared-state /
+blocking-call verification against the lock-hierarchy registry
+(spark_tpu/locks.py).
+
+Companion to tools/lint_invariants.py in the tier-1 flow; the analysis
+itself lives in spark_tpu/analysis/concurrency.py so tests and the
+engine can import it. Rules (stable Diagnostic codes):
+
+- CONC-ORDER-CYCLE   lock-acquisition edge inverting locks.LOCK_RANKS,
+                     or a cycle among unranked locks
+- CONC-UNLOCKED-MUT  shared state mutated under a lock somewhere but
+                     bare elsewhere
+- CONC-BLOCKING-HELD blocking call (queue/HTTP/file IO/subprocess/
+                     sleep/device sync) while holding a lock
+- CONC-WAIT-NOLOOP   Condition.wait outside a predicate loop
+
+Exemptions live in ``[tool.lint-concurrency]`` in pyproject.toml and
+MUST carry a non-empty justification string; an empty justification or
+a stale key (matching nothing in the tree) is itself a finding, so the
+table can never silently rot.
+
+Exit 0 when clean, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_CONFIG: Dict[str, object] = {
+    "paths": ["spark_tpu"],
+    #: files the analyzer must not scan: locks.py IS the proxy layer
+    #: (its acquire/release would read as self-nesting)
+    "exclude": ["spark_tpu/locks.py"],
+    #: lock-variable aliases: bindings the AST cannot see through
+    #: (assignment of another object's lock)
+    "aliases": {},
+    #: "<rel>::<Class>._attr" / "<rel>::_VAR" -> justification
+    "exempt_unlocked": {},
+    #: "<rel>::<qualname>" -> justification
+    "exempt_blocking": {},
+}
+
+
+def _load_config() -> Dict[str, object]:
+    """DEFAULT_CONFIG merged with ``[tool.lint-concurrency]`` from
+    pyproject.toml (sub-tables ``aliases`` / ``exempt-unlocked`` /
+    ``exempt-blocking``)."""
+    cfg = {k: (dict(v) if isinstance(v, dict) else list(v))
+           for k, v in DEFAULT_CONFIG.items()}
+    try:
+        import tomllib
+    except ImportError:  # py<3.11: tomli is API-compatible
+        try:
+            import tomli as tomllib
+        except ImportError:  # pragma: no cover
+            return cfg
+    path = os.path.join(REPO_ROOT, "pyproject.toml")
+    try:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+    except FileNotFoundError:  # pragma: no cover
+        return cfg
+    section = data.get("tool", {}).get("lint-concurrency", {})
+    for key in ("paths", "exclude"):
+        if key in section:
+            cfg[key] = list(section[key])
+    for toml_key, cfg_key in (("aliases", "aliases"),
+                              ("exempt-unlocked", "exempt_unlocked"),
+                              ("exempt-blocking", "exempt_blocking")):
+        if toml_key in section:
+            cfg[cfg_key] = dict(section[toml_key])
+    return cfg
+
+
+def _iter_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        root = os.path.join(REPO_ROOT, p)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _exemption_findings(cfg, diagnostics_module) -> List:
+    """Typed findings for malformed exemption tables: every entry must
+    carry a non-empty justification, and every key must still match
+    something scannable (a stale key means the code it excused is gone
+    or moved — the table must follow)."""
+    from spark_tpu.analysis.diagnostics import Diagnostic
+
+    out = []
+    known_rels = set()
+    for path in _iter_py_files(list(cfg["paths"])):
+        known_rels.add(os.path.relpath(path, REPO_ROOT))
+    for table, name in ((cfg["exempt_unlocked"], "exempt-unlocked"),
+                        (cfg["exempt_blocking"], "exempt-blocking"),
+                        (cfg["aliases"], "aliases")):
+        for key, justification in table.items():
+            if not str(justification).strip():
+                out.append(Diagnostic(
+                    code="CONC-EXEMPT-UNJUSTIFIED", level="error",
+                    node=f"pyproject.toml [{name}]",
+                    message=f"exemption {key!r} has no justification",
+                    hint="every exemption must say WHY it is safe"))
+            rel = key.split("::", 1)[0]
+            if rel not in known_rels:
+                out.append(Diagnostic(
+                    code="CONC-EXEMPT-STALE", level="error",
+                    node=f"pyproject.toml [{name}]",
+                    message=f"exemption {key!r} references "
+                            f"{rel}, which is not in the scanned tree",
+                    hint="delete or update the stale entry"))
+    return out
+
+
+def run_lint(config=None) -> List:
+    """All findings over the configured tree; importable for tests."""
+    from spark_tpu.analysis import concurrency
+
+    cfg = config if config is not None else _load_config()
+    exclude = set(cfg.get("exclude", []))
+    sources: Dict[str, str] = {}
+    for path in _iter_py_files(list(cfg["paths"])):
+        rel = os.path.relpath(path, REPO_ROOT)
+        if rel in exclude:
+            continue
+        with open(path, encoding="utf-8") as f:
+            sources[rel] = f.read()
+    findings = concurrency.analyze_sources(
+        sources,
+        aliases=dict(cfg.get("aliases", {})),
+        exempt_unlocked=dict(cfg.get("exempt_unlocked", {})),
+        exempt_blocking=dict(cfg.get("exempt_blocking", {})))
+    findings.extend(_exemption_findings(cfg, None))
+    return findings
+
+
+def main() -> int:
+    findings = run_lint()
+    for d in findings:
+        print(d.format())
+    if findings:
+        print(f"lint_concurrency: {len(findings)} finding(s)")
+        return 1
+    print("lint_concurrency: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,8 +93,11 @@ def _load_config() -> dict:
     pyproject = os.path.join(REPO_ROOT, "pyproject.toml")
     try:
         import tomllib
-    except ImportError:  # py<3.11: defaults only
-        return cfg
+    except ImportError:  # py<3.11: tomli is API-compatible
+        try:
+            import tomli as tomllib
+        except ImportError:
+            return cfg
     try:
         with open(pyproject, "rb") as f:
             data = tomllib.load(f)
