@@ -289,12 +289,21 @@ class DataFrame:
     # ---- actions -----------------------------------------------------------
 
     def _execute(self):
-        from spark_tpu import trace
+        from spark_tpu import deadline, recovery, trace
 
         # root span when standalone; child when a connect server /
-        # scheduler ticket already carries a trace for this query
-        with trace.span("query.execute",
-                        plan=type(self._plan).__name__):
+        # scheduler ticket already carries a trace for this query.
+        # same shape for resilience context: an ambient deadline /
+        # retry budget (scheduler ticket, connect request) is kept;
+        # standalone, a default deadline is minted from
+        # spark.tpu.deadline.defaultTimeoutS and a fresh per-query
+        # retry budget is bound so every retry seam below draws from
+        # ONE pool instead of multiplying per-layer caps
+        conf = self._session.conf if self._session is not None else None
+        with deadline.bind_default(conf), \
+                recovery.bind_default_budget(conf), \
+                trace.span("query.execute",
+                           plan=type(self._plan).__name__):
             return self._execute_traced()
 
     def _execute_traced(self):

@@ -492,6 +492,14 @@ class CompileService:
                 self._jobs.append(t)
             t.start()
             return None
+        if metrics.brownout_level() > 0:
+            # fleet brownout: pre-warm is exactly the analysis-heavy
+            # optional work the fleet sheds FIRST under pressure —
+            # skipping it costs warmth, never correctness
+            metrics.record("compile", phase="prewarm_brownout_skip",
+                           level=metrics.brownout_level())
+            return {"replayed": [], "skipped": [], "errors": [],
+                    "brownout": True}
         conf = session.conf
         if budget_s is None:
             budget_s = float(conf.get(CF.COMPILE_PREWARM_BUDGET_S))

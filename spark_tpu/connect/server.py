@@ -13,7 +13,7 @@ from typing import Optional
 
 import pyarrow as pa
 
-from spark_tpu import faults, trace
+from spark_tpu import deadline, faults, trace
 
 
 class ConnectServer:
@@ -162,10 +162,15 @@ class ConnectServer:
                 # adopt the caller's trace (client or federation
                 # router) so this request's spans — scheduler, stages,
                 # faults — join the fleet-wide trace; a missing/bad
-                # header starts a fresh root here
+                # header starts a fresh root here. The caller's
+                # absolute deadline rides the same hop: binding it
+                # here puts it in scope for the scheduler ticket and
+                # every retry/wait seam under this request.
                 rctx = trace.from_header(
                     self.headers.get(trace.TRACE_HEADER))
-                with trace.attach(rctx), \
+                rdl = deadline.from_header(
+                    self.headers.get(deadline.DEADLINE_HEADER))
+                with trace.attach(rctx), deadline.bind(rdl), \
                         trace.span("connect.request", path=self.path,
                                    replica=outer.replica_id):
                     self._handle_query(n)
@@ -173,6 +178,10 @@ class ConnectServer:
             def _handle_query(self, n: int) -> None:
                 try:
                     faults.inject("connect.request", outer.session.conf)
+                    # a request whose caller-deadline already passed in
+                    # transit is dead on arrival: answer typed with
+                    # ZERO scheduler submits and zero device work
+                    deadline.check("connect.request")
                     req = json.loads(self.rfile.read(n))
 
                     def build_df():
@@ -382,14 +391,25 @@ class Client:
               pool: Optional[str] = None) -> pa.Table:
         # one client-side span across every retry attempt: the whole
         # request (including backoff) is a single unit of the trace,
-        # and each attempt ships the span context in X-SparkTpu-Trace
-        with trace.span("connect.client", path=path):
+        # and each attempt ships the span context in X-SparkTpu-Trace.
+        # The per-request timeout mints the ABSOLUTE deadline the
+        # whole fleet honors (X-SparkTpu-Deadline); an already-bound
+        # tighter caller deadline wins inside bind().
+        with deadline.bind(deadline.mint(self.timeout)), \
+                trace.span("connect.client", path=path):
             return self._post_retrying(path, payload, pool)
 
     def _post_retrying(self, path: str, payload: dict,
                        pool: Optional[str] = None) -> pa.Table:
         import time as _time
 
+        from spark_tpu.recovery import RetryBudget
+
+        # a request-local budget (the client has no session conf):
+        # same draw discipline and counters as the server-side seams
+        budget = RetryBudget(self.retries, layer_floor=0,
+                             backoff_base_s=self.backoff_s,
+                             backoff_cap_s=self.max_backoff_s)
         last: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
             try:
@@ -404,12 +424,19 @@ class Client:
                     ConnectionAbortedError, BrokenPipeError) as e:
                 last = e
                 delay = self._jitter(attempt)
-            if attempt >= self.retries:
+            if attempt >= self.retries \
+                    or not budget.draw("connect.client"):
                 break
-            _time.sleep(delay)
+            # never sleep past the request deadline (a Retry-After
+            # floor beyond it used to put the client to sleep through
+            # its own timeout), and fail FAST with the typed error
+            # once the window closes instead of burning an attempt on
+            # a doomed round trip
+            _time.sleep(deadline.cap_sleep(delay))
+            deadline.check("connect.client")
         raise RuntimeError(
             f"connect request to {self.url + path} failed after "
-            f"{self.retries + 1} attempts (last: {last!r})") from last
+            f"{attempt + 1} attempts (last: {last!r})") from last
 
     def _post_once(self, path: str, payload: dict,
                    pool: Optional[str] = None) -> pa.Table:
@@ -425,12 +452,21 @@ class Client:
         hv = trace.header_value()
         if hv:
             headers[trace.TRACE_HEADER] = hv
+        dv = deadline.header_value()
+        if dv:
+            headers[deadline.DEADLINE_HEADER] = dv
+        # the socket timeout shrinks with the request deadline: a
+        # retry attempt near the window's end gets only what is left
+        timeout = self.timeout
+        rem = deadline.remaining()
+        if rem is not None:
+            timeout = max(0.001, min(timeout, rem))
         req = urllib.request.Request(
             self.url + path,
             data=json.dumps(payload).encode(), headers=headers)
         try:
             with urllib.request.urlopen(req,
-                                        timeout=self.timeout) as resp:
+                                        timeout=timeout) as resp:
                 data = resp.read()
                 rid = resp.headers.get("X-SparkTpu-Replica")
                 if rid:

@@ -71,9 +71,15 @@ Spec grammar (the conf value):
 - ``nth:K[:kind]``       fire exactly once, on the K-th arrival at the
                          point (1-based) — the deterministic workhorse
 - ``prob:P:SEED[:kind]`` fire each arrival with probability P from a
-                         dedicated ``random.Random(SEED)`` stream —
-                         deterministic across reruns, independent of
-                         any other RNG use
+                         dedicated ``random.Random(f"{SEED}:{point}")``
+                         stream — deterministic across reruns,
+                         independent of any other RNG use, and ISOLATED
+                         per point: the stream is salted with the point
+                         name, so one point's draw count never perturbs
+                         another point's sequence and a multi-point
+                         chaos schedule reproduces from the campaign
+                         seed alone (str seeding hashes via sha512 —
+                         stable across processes and PYTHONHASHSEED)
 
 Fault kinds (default ``transient``):
 
@@ -216,11 +222,15 @@ def parse_spec(spec: str) -> Optional[_Spec]:
 class _PointState:
     __slots__ = ("calls", "fired", "rng")
 
-    def __init__(self, spec: _Spec):
+    def __init__(self, point: str, spec: _Spec):
         self.calls = 0
         self.fired = 0
-        self.rng = random.Random(spec.seed) if spec.mode == "prob" \
-            else None
+        # the stream is salted with the point name: two points armed
+        # from one campaign seed draw DECORRELATED sequences, and one
+        # point's arrival count cannot shift another's draws — the
+        # reproducibility contract multi-point chaos schedules rely on
+        self.rng = random.Random(f"{spec.seed}:{point}") \
+            if spec.mode == "prob" else None
 
 
 _LOCK = locks.named_lock("faults.registry")
@@ -245,7 +255,7 @@ def _state(conf, point: str, spec_str: str, spec: _Spec) -> _PointState:
     key: Tuple[str, str] = (point, spec_str)
     st = states.get(key)
     if st is None:
-        st = states[key] = _PointState(spec)
+        st = states[key] = _PointState(point, spec)
     return st
 
 
@@ -302,8 +312,13 @@ def inject(point: str, conf=None) -> None:
             point, f"DATA_LOSS: injected corruption at {point} "
                    f"(call {calls})")
     if spec.kind == "hang":
+        # the sleep never outlives the caller: a bound deadline caps it
+        # (the injected "hang" models exactly the wait a real deadline
+        # would cut short)
+        from spark_tpu import deadline as _deadline
+
         delay = float(conf.get(HANG_SECONDS))
-        time.sleep(max(0.0, delay))
+        time.sleep(_deadline.cap_sleep(max(0.0, delay)))
         raise InjectedDeadlineError(
             point, f"DEADLINE_EXCEEDED: injected hang at {point} "
                    f"surfaced after {delay:g}s (call {calls})")

@@ -309,7 +309,12 @@ class FileSource:
             hit = store.get(skey, pin=True)
             if hit is not None:
                 return hit
-        hot = store is not None and self._read_counts[key] >= threshold
+        # auto-cache promotion is optional work: under fleet brownout
+        # the scan still serves (and store hits above still hit), it
+        # just stops PROMOTING new entries into HBM
+        hot = (store is not None
+               and self._read_counts[key] >= threshold
+               and metrics.brownout_level() == 0)
         hit = self._cache.get(key)
         if hit is not None:
             self._cache[key] = self._cache.pop(key)  # LRU touch

@@ -54,12 +54,15 @@ LOCK_RANKS: Dict[str, int] = {
     "pipeline.cond": 350,            # ChunkPipeline._cond: inflight budget
     "serve.result_cache": 360,       # ResultCache._flights map
     "serve.federation": 370,         # FederationRouter round-robin state
+    "serve.breaker": 380,            # per-replica CircuitBreaker window
+    "serve.brownout": 385,           # BrownoutController pressure window
     # --- storage / memory manager (inner: leaf data structures) ------
     "storage.unified": 400,          # UnifiedMemoryManager.lock (RLock,
     #                                  shared with MemoryStore._lock)
     "storage.lru": 420,              # LruDict._lock (serve blob cache)
     "admission.measured": 440,       # measured plan-bytes table
     "streaming.source": 460,         # streaming source buffers
+    "recovery.retry_budget": 470,    # per-query RetryBudget pool state
     "recovery.checkpoint": 480,      # checkpoint dir init
     "faults.registry": 500,          # fault-injection spec table
     "native.registry": 520,          # pallas kernel registry

@@ -359,7 +359,8 @@ def reset_exec_store() -> None:
 #: /api/v1/serve.
 _SERVE = {"hits": 0, "misses": 0, "waits": 0, "wait_timeouts": 0,
           "dispatches": 0, "sheds": 0, "redispatches": 0,
-          "rejected": 0, "replica_failures": 0}
+          "rejected": 0, "replica_failures": 0,
+          "breaker_transitions": 0}
 
 
 def note_serve(kind: str, n: int = 1) -> None:
@@ -497,6 +498,77 @@ def reset_recovery() -> None:
     with _LOCK:
         for k in list(_RECOVERY):
             _RECOVERY[k] = 0
+
+
+# ---- unified retry-budget counters ------------------------------------------
+
+#: the per-query unified retry budget (recovery.RetryBudget) — ``draws``
+#: counts every granted re-attempt across ALL layers (the per-query sum
+#: is bounded by the budget instead of the old multiplicative product of
+#: per-layer bounds), ``floor_draws`` the subset granted by a layer's
+#: floor guarantee after the shared pool emptied, ``denials`` refused
+#: draws (the seam surfaces RetryBudgetExhausted), ``exhaustions`` the
+#: times a pool first hit empty, and ``legacy_attempts`` re-attempts
+#: taken on the budget-less fallback path (the A/B counter the chaos
+#: campaign compares against the budgeted path).
+_RETRY = {"draws": 0, "floor_draws": 0, "denials": 0, "exhaustions": 0,
+          "legacy_attempts": 0}
+
+
+def note_retry_budget(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _RETRY[kind] = _RETRY.get(kind, 0) + int(n)
+
+
+def retry_budget_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_RETRY)
+
+
+def reset_retry_budget() -> None:
+    with _LOCK:
+        for k in list(_RETRY):
+            _RETRY[k] = 0
+
+
+# ---- fleet brownout level ----------------------------------------------------
+
+#: fleet-wide brownout (serve/federation.py BrownoutController) —
+#: ``level`` is the CURRENT shedding level (0 = normal; 1 = optional
+#: analysis-heavy work shed: trace sampling, compile pre-warm, scan
+#: auto-cache promotion), ``entered``/``exited`` count transitions.
+#: Stored here (not on the controller) so consumers at the bottom of
+#: the import graph — trace sampling, the datasource — read one int
+#: without importing the serve tier.
+_BROWNOUT = {"level": 0, "entered": 0, "exited": 0}
+
+
+def set_brownout(level: int) -> None:
+    with _LOCK:
+        prev = _BROWNOUT["level"]
+        level = int(level)
+        if level > prev:
+            _BROWNOUT["entered"] = _BROWNOUT.get("entered", 0) + 1
+        elif level < prev:
+            _BROWNOUT["exited"] = _BROWNOUT.get("exited", 0) + 1
+        _BROWNOUT["level"] = level
+
+
+def brownout_level() -> int:
+    with _LOCK:
+        return int(_BROWNOUT["level"])
+
+
+def brownout_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_BROWNOUT)
+
+
+def reset_brownout() -> None:
+    with _LOCK:
+        _BROWNOUT["level"] = 0
+        _BROWNOUT["entered"] = 0
+        _BROWNOUT["exited"] = 0
 
 
 class PipelineStats:

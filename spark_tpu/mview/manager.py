@@ -372,13 +372,17 @@ class ViewManager:
             retries = max(0, int(self._conf.get(CF.MVIEW_REFRESH_RETRIES)))
         except Exception:
             retries = 2
+        from spark_tpu import deadline
+
         last: Optional[BaseException] = None
         for attempt in range(retries + 1):
             try:
                 return fn(), True
             except Exception as exc:
                 last = exc
-                if recovery.is_transient(exc) and attempt < retries:
+                if (recovery.is_transient(exc) and attempt < retries
+                        and not deadline.expired()
+                        and recovery.retry_allowed("mview.refresh")):
                     metrics.note_mview("refresh_retries")
                     metrics.record("mview", phase="retry",
                                    error=type(exc).__name__,

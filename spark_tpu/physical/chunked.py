@@ -878,7 +878,7 @@ def _spill_seam(conf, op: str, attempts: int, fn):
     BEFORE ``fn`` touches any file, so a retried injected fault never
     sees partial writes; real mid-write I/O errors are not transient
     and abort to the recompute-from-source fallback."""
-    from spark_tpu import faults, metrics, recovery, trace
+    from spark_tpu import deadline, faults, metrics, recovery, trace
 
     attempts = max(0, int(attempts))
     last: Optional[BaseException] = None
@@ -887,10 +887,18 @@ def _spill_seam(conf, op: str, attempts: int, fn):
             with trace.span("join.spill", op=op, attempt=attempt):
                 faults.inject("join.spill", conf)
                 return fn()
+        except deadline.DeadlineExceeded:
+            # not a spill failure: the query's window closed, so the
+            # abort-to-grace-hash fallback would just burn more time
+            raise
         except Exception as e:
             if recovery.is_oom(e):
                 raise
             if recovery.is_transient(e) and attempt < attempts:
+                deadline.check(f"join.spill.{op}")
+                if not recovery.retry_allowed("join.spill"):
+                    raise recovery.RetryBudgetExhausted(
+                        "join.spill", recovery.current_budget()) from e
                 last = e
                 metrics.note_join("spill_retries")
                 metrics.record("stage_retry", label=f"join.spill.{op}",

@@ -35,7 +35,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 
 from spark_tpu import locks
 from spark_tpu import conf as CF
-from spark_tpu import faults, metrics, trace
+from spark_tpu import deadline, faults, metrics, trace
 from spark_tpu.metrics import PipelineStats
 
 CHUNK_RETRY_ATTEMPTS = CF.register(
@@ -90,8 +90,15 @@ class ChunkPipeline:
         self._thread: Optional[threading.Thread] = None
         # capture the caller's span context so producer-side chunk
         # spans (pipeline.decode/transfer) join the query's trace even
-        # though they run on the background thread
+        # though they run on the background thread; the caller's
+        # deadline and retry budget cross the same thread boundary so
+        # producer-side retries stay bounded by the query's pool and
+        # stop when the caller's window closes
         self._trace_ctx = metrics.trace_context()
+        self._deadline = deadline.current()
+        from spark_tpu import recovery
+
+        self._retry_budget = recovery.current_budget()
         if self._depth >= 1:
             self._queue: queue.Queue = queue.Queue(maxsize=self._depth)
             self._cond = locks.named_condition("pipeline.cond")
@@ -146,9 +153,14 @@ class ChunkPipeline:
                     or isinstance(e, faults.InjectedFault))
                 if not retryable or attempt + 1 >= self._retry_attempts:
                     raise
+                deadline.check("pipeline.chunk")
+                if not recovery.retry_allowed("pipeline.chunk"):
+                    raise recovery.RetryBudgetExhausted(
+                        "pipeline.chunk", recovery.current_budget()) from e
                 metrics.record("chunk_retry", attempt=attempt + 1,
                                error=repr(e))
-                time.sleep(min(0.05 * 2 ** attempt, 0.5))
+                time.sleep(deadline.cap_sleep(
+                    min(0.05 * 2 ** attempt, 0.5)))
         raise AssertionError("unreachable")  # loop always returns/raises
 
     # ---- serial path (depth == 0) -----------------------------------------
@@ -168,7 +180,11 @@ class ChunkPipeline:
     # ---- threaded path -----------------------------------------------------
 
     def _produce(self) -> None:
-        with trace.attach(self._trace_ctx):
+        from spark_tpu import recovery
+
+        with trace.attach(self._trace_ctx), \
+                deadline.bind(self._deadline), \
+                recovery.bind_budget(self._retry_budget):
             self._produce_traced()
 
     def _produce_traced(self) -> None:

@@ -119,6 +119,8 @@ class RDD:
         return part
 
     def _run_task(self, i: int) -> List[Any]:
+        from spark_tpu import recovery
+
         attempts = int(self._sc._conf_get(TASK_MAX_FAILURES))
         last: Optional[BaseException] = None
         for attempt in range(max(1, attempts)):
@@ -126,6 +128,9 @@ class RDD:
                 return list(self._compute(i))
             except Exception as e:  # lineage recompute on next attempt
                 last = e
+                if attempt + 1 < max(1, attempts) \
+                        and not recovery.retry_allowed("rdd.task"):
+                    break
         raise RuntimeError(
             f"task failed {attempts} times: {self._name} partition {i}"
         ) from last

@@ -23,15 +23,17 @@ so the recovery unit is the stage program, not a task.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 import uuid
-from contextlib import nullcontext
-from typing import Callable, Iterator, Optional
+from contextlib import contextmanager, nullcontext
+from contextvars import ContextVar
+from typing import Callable, Dict, Iterator, Optional
 
 from spark_tpu import locks
 from spark_tpu import conf as CF
-from spark_tpu import faults, metrics, trace
+from spark_tpu import deadline, faults, metrics, trace
 
 STAGE_MAX_ATTEMPTS = CF.register(
     "spark.stage.maxConsecutiveAttempts", 4,
@@ -61,6 +63,37 @@ OOM_DEGRADE_FLOOR = CF.register(
     "spark.tpu.oomDegrade.floorBytes", 1 << 20,
     "Smallest device-batch budget the OOM degradation ladder will try "
     "before giving up and surfacing the original OOM.", int)
+
+RETRY_BUDGET_ENABLED = CF.register(
+    "spark.tpu.recovery.retryBudget.enabled", True,
+    "Share ONE per-query retry budget across every retry layer (stage "
+    "recovery, scheduler admission, chunk pipeline, spill seams, mview "
+    "refresh, dispatch re-forward) instead of letting the per-layer "
+    "bounds stack multiplicatively under a fault storm.", bool)
+
+RETRY_BUDGET_ATTEMPTS = CF.register(
+    "spark.tpu.recovery.retryBudget.attempts", 8,
+    "Total re-attempts one query may spend across ALL retry layers "
+    "combined. Per-layer bounds still apply individually; this pool "
+    "caps their sum.", int)
+
+RETRY_BUDGET_FLOOR = CF.register(
+    "spark.tpu.recovery.retryBudget.layerFloor", 1,
+    "Re-attempts each layer is guaranteed even after the shared pool "
+    "empties, so one retry-hungry layer cannot starve every other "
+    "layer of its single recovery chance.", int)
+
+RETRY_BACKOFF_BASE = CF.register(
+    "spark.tpu.recovery.retryBudget.backoffBaseS", 0.05,
+    "Base of the full-jitter exponential backoff between budgeted "
+    "re-attempts (delay ~ uniform[0, min(cap, base * 2^attempt)]).",
+    float)
+
+RETRY_BACKOFF_CAP = CF.register(
+    "spark.tpu.recovery.retryBudget.backoffCapS", 2.0,
+    "Ceiling of the full-jitter backoff between budgeted re-attempts; "
+    "every sleep is additionally capped by the caller's remaining "
+    "deadline.", float)
 
 # Error-message fragments that indicate the *environment* failed (a
 # host dropped out of the collective, the tunnel died, a deadline
@@ -130,6 +163,18 @@ def is_transient(exc: BaseException) -> bool:
     if is_oom(exc):
         return False
     for e in _chain(exc):
+        # typed carve-outs BEFORE the marker scan: a caller-deadline
+        # expiry says "DEADLINE_EXCEEDED" (a transient marker, because
+        # a *server-side* grpc deadline is worth one retry) but the
+        # CALLER being gone is terminal; likewise a drained retry
+        # budget must not be re-retried by an outer layer — its cause
+        # chain carries the original UNAVAILABLE-style error and would
+        # otherwise classify transient, resurrecting the exact
+        # multiplicative stacking the budget exists to remove.
+        if isinstance(e, (deadline.DeadlineExceeded,
+                          RetryBudgetExhausted)):
+            return False
+    for e in _chain(exc):
         if isinstance(e, (faults.InjectedTransientError,
                           faults.InjectedDeadlineError)):
             return True
@@ -147,6 +192,189 @@ def is_transient(exc: BaseException) -> bool:
         if any(m in msg for m in _TRANSIENT_MARKERS):
             return True
     return False
+
+
+class RetryBudgetExhausted(RuntimeError):
+    """A retry seam asked for a re-attempt after the query's unified
+    retry budget drained past the layer floor. Typed and terminal:
+    never transient (is_transient carves it out by type), so outer
+    layers surface it instead of re-retrying."""
+
+    def __init__(self, layer: str, budget: Optional["RetryBudget"]):
+        snap = budget.snapshot() if budget is not None else \
+            {"draws": "?", "attempts": "?", "layers": {}}
+        super().__init__(
+            f"RETRY_BUDGET_EXHAUSTED at {layer}: "
+            f"{snap['draws']} re-attempts spent of "
+            f"{snap['attempts']} budgeted "
+            f"(per-layer: {snap['layers']})")
+        self.layer = layer
+
+
+class RetryBudget:
+    """One per-query pool of re-attempts shared by EVERY retry layer.
+
+    Before this existed, resilience was a stack of independent bounded
+    retries — ``serve.dispatchRetries`` x ``scheduler.admit`` re-admits
+    x ``chunkRetryAttempts`` x ``spillRetryAttempts`` x
+    ``mview.refreshRetries`` — whose worst case is the PRODUCT of the
+    bounds under a fault storm. Here every layer draws from one pool:
+    the per-query total is the SUM bound ``attempts`` (plus each
+    layer's small floor guarantee), whatever the nesting.
+
+    ``draw(layer)`` consumes one re-attempt and returns whether it was
+    granted; after the pool drains, a layer that has drawn fewer than
+    ``layer_floor`` times is still granted (the floor keeps one
+    retry-hungry layer from starving every other layer of its single
+    recovery chance). Denials surface as
+    :class:`RetryBudgetExhausted` at the seam.
+
+    ``backoff_s(attempt)`` is the shared FULL-JITTER exponential
+    backoff — delay ~ uniform[0, min(cap, base * 2^attempt)] — capped
+    by the caller's remaining deadline, so no budgeted sleep outlives
+    the caller.
+    """
+
+    def __init__(self, attempts: int, *, layer_floor: int = 1,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0,
+                 rng: Optional[random.Random] = None):
+        self.attempts = max(0, int(attempts))
+        self.layer_floor = max(0, int(layer_floor))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._rng = rng if rng is not None else random.Random()
+        self._remaining = self.attempts
+        self._layers: Dict[str, int] = {}
+        self._exhausted_noted = False
+        self._lock = locks.named_lock("recovery.retry_budget")
+
+    def draw(self, layer: str) -> bool:
+        """Consume one re-attempt for ``layer``; True when granted."""
+        with self._lock:
+            taken = self._layers.get(layer, 0)
+            if self._remaining > 0:
+                self._remaining -= 1
+                self._layers[layer] = taken + 1
+                granted, floored = True, False
+            elif taken < self.layer_floor:
+                self._layers[layer] = taken + 1
+                granted, floored = True, True
+            else:
+                granted, floored = False, False
+            remaining = self._remaining
+            note_exhausted = (remaining == 0
+                              and not self._exhausted_noted)
+            if note_exhausted:
+                self._exhausted_noted = True
+        if granted:
+            metrics.note_retry_budget("draws")
+            if floored:
+                metrics.note_retry_budget("floor_draws")
+        else:
+            metrics.note_retry_budget("denials")
+        if note_exhausted:
+            metrics.note_retry_budget("exhaustions")
+        metrics.record("retry_draw", layer=layer, granted=granted,
+                       floored=floored, remaining=remaining)
+        return granted
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter delay for re-attempt ``attempt``, capped by the
+        ambient deadline's remaining time."""
+        ceiling = min(self.backoff_cap_s,
+                      self.backoff_base_s * (2.0 ** max(0, attempt)))
+        return deadline.cap_sleep(self._rng.uniform(0.0, ceiling))
+
+    def sleep(self, attempt: int) -> None:
+        time.sleep(self.backoff_s(attempt))
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"attempts": self.attempts,
+                    "remaining": self._remaining,
+                    "draws": sum(self._layers.values()),
+                    "layers": dict(self._layers),
+                    "layer_floor": self.layer_floor}
+
+
+_BUDGET: ContextVar[Optional[RetryBudget]] = ContextVar(
+    "spark_tpu_retry_budget", default=None)
+
+
+def current_budget() -> Optional[RetryBudget]:
+    """The query's ambient RetryBudget (None outside a budgeted query
+    or with spark.tpu.recovery.retryBudget.enabled=false)."""
+    return _BUDGET.get()
+
+
+@contextmanager
+def bind_budget(budget: Optional[RetryBudget]):
+    """Enter a budget for the dynamic extent (None is a no-op).
+    Thread-hopping code captures current_budget() and re-binds on the
+    worker — same discipline as trace/deadline contexts."""
+    if budget is None:
+        yield _BUDGET.get()
+        return
+    token = _BUDGET.set(budget)
+    try:
+        yield budget
+    finally:
+        _BUDGET.reset(token)
+
+
+def budget_from_conf(conf) -> Optional[RetryBudget]:
+    """A fresh per-query budget per the conf (None when disabled)."""
+    try:
+        if not bool(conf.get(RETRY_BUDGET_ENABLED)):
+            return None
+        return RetryBudget(
+            int(conf.get(RETRY_BUDGET_ATTEMPTS)),
+            layer_floor=int(conf.get(RETRY_BUDGET_FLOOR)),
+            backoff_base_s=float(conf.get(RETRY_BACKOFF_BASE)),
+            backoff_cap_s=float(conf.get(RETRY_BACKOFF_CAP)))
+    except Exception:
+        return None
+
+
+@contextmanager
+def bind_default_budget(conf):
+    """Root-entry helper (DataFrame._execute): bind a fresh budget only
+    when none is already active — nested executions (mview refresh,
+    cache materialization, recovery re-runs) must share the OUTER
+    query's pool; that sharing IS the anti-stacking guarantee."""
+    if _BUDGET.get() is not None or conf is None:
+        yield _BUDGET.get()
+        return
+    with bind_budget(budget_from_conf(conf)) as b:
+        yield b
+
+
+def retry_allowed(layer: str) -> bool:
+    """THE seam API: every bounded-retry loop in the tree asks this
+    before each re-attempt (tools/lint_invariants.py rule 7 enforces
+    it). Draws from the ambient budget when one is bound; without one
+    (budget disabled, or a bare layer used outside any query) the
+    legacy per-layer bound stands alone and the re-attempt is counted
+    on the ``legacy_attempts`` A/B counter."""
+    b = _BUDGET.get()
+    if b is None:
+        metrics.note_retry_budget("legacy_attempts")
+        return True
+    return b.draw(layer)
+
+
+def backoff_sleep(attempt: int, *, base_s: float = 0.05,
+                  cap_s: float = 2.0) -> None:
+    """Full-jitter, deadline-capped backoff for seams re-attempting
+    WITHOUT an ambient budget (the budget's own backoff_s is preferred
+    when bound — it shares the jitter RNG and the configured caps)."""
+    b = _BUDGET.get()
+    if b is not None:
+        b.sleep(attempt)
+        return
+    ceiling = min(float(cap_s), float(base_s) * (2.0 ** max(0, attempt)))
+    time.sleep(deadline.cap_sleep(random.uniform(0.0, ceiling)))
 
 
 def _note_measured_resident(lp) -> None:
@@ -346,7 +574,9 @@ def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
     """Run ``fn`` (a stage/query execution thunk), retrying transient
     environment failures up to spark.stage.maxConsecutiveAttempts times.
     Each retry recomputes from lineage — ``fn`` must replan from the
-    logical plan, not replay captured device buffers."""
+    logical plan, not replay captured device buffers. Re-attempts draw
+    from the query's unified RetryBudget (retry_allowed) and every
+    backoff sleep is capped by the caller's remaining deadline."""
     attempts = int(conf.get(STAGE_MAX_ATTEMPTS)) if conf is not None \
         else STAGE_MAX_ATTEMPTS.default
     last: Optional[BaseException] = None
@@ -369,7 +599,13 @@ def run_stage_with_recovery(fn: Callable, *, conf=None, label: str = "stage"):
             last = e
             metrics.record("stage_retry", label=label, attempt=attempt,
                            error=repr(e))
-            time.sleep(min(2.0 ** attempt * 0.1, 2.0))
+            if attempt + 1 >= max(1, attempts):
+                break
+            deadline.check(label)  # the caller may already be gone
+            if not retry_allowed(label):
+                b = _BUDGET.get()
+                raise RetryBudgetExhausted(label, b) from last
+            backoff_sleep(attempt, base_s=0.1, cap_s=2.0)
     raise RuntimeError(
         f"{label} failed {attempts} consecutive attempts "
         f"(last: {last!r})") from last

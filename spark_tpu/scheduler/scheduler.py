@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from spark_tpu import locks
 from spark_tpu import conf as CF
+from spark_tpu import deadline as DL
 from spark_tpu import faults, metrics
 from spark_tpu.scheduler.admission import (AdmissionController,
                                            estimate_plan_bytes)
@@ -91,6 +92,9 @@ class QueryTicket:
         self._done = threading.Event()
         self._cancel = threading.Event()
         self._charge = 0  # admission bytes currently held
+        #: per-query unified RetryBudget, attached by the worker at
+        #: execution start (None before that / when disabled)
+        self.retry_budget = None
         self._granted = False  # holding an admission grant (charge may
         # legitimately be 0 when storage eviction covered the footprint)
         # span context of the submitting thread (connect request /
@@ -212,6 +216,12 @@ class QueryScheduler:
         p = self.pools.get(pool)
         deadline = time.time() + float(deadline_s) \
             if deadline_s is not None else None
+        # the submitter's propagated absolute deadline (connect header,
+        # collect-minted) rides onto the ticket; the tighter bound wins
+        ambient = DL.current()
+        if ambient is not None:
+            deadline = ambient if deadline is None \
+                else min(deadline, ambient)
         with self._cond:
             if self._stopped:
                 raise RuntimeError("scheduler is stopped")
@@ -364,13 +374,18 @@ class QueryScheduler:
             self._execute(t)
 
     def _execute(self, t: QueryTicket) -> None:
-        from spark_tpu import trace
+        from spark_tpu import recovery, trace
 
         # worker threads don't inherit the submitter's contextvars;
         # re-enter the captured span context so the run attributes to
         # the submitting request's trace (root here for tickets
-        # submitted outside any trace)
-        with trace.attach(t._trace_ctx):
+        # submitted outside any trace). The ticket's absolute deadline
+        # and a fresh per-query RetryBudget enter scope the same way:
+        # every retry/wait seam under this worker draws from ONE pool
+        # and stops when the caller's deadline passes.
+        t.retry_budget = recovery.budget_from_conf(self._conf)
+        with trace.attach(t._trace_ctx), DL.bind(t.deadline), \
+                recovery.bind_budget(t.retry_budget):
             with trace.span("scheduler.run", id=t.id, pool=t.pool):
                 self._execute_traced(t)
 
@@ -391,7 +406,7 @@ class QueryScheduler:
             t.check_cancelled()
             out = t._run(t)
             self._finish(t, FINISHED, result=out)
-        except QueryCancelled as e:
+        except (QueryCancelled, DL.DeadlineExceeded) as e:
             self._finish(t, CANCELLED, error=e)
         except Exception as e:  # noqa: BLE001 — typed via ticket.error
             self._finish(t, FAILED, error=e)
@@ -454,6 +469,14 @@ class QueryScheduler:
                     metrics.record("stage_retry",
                                    label="scheduler.admit",
                                    attempt=attempt, error=repr(e))
+                    # a re-admission is a re-attempt like any other:
+                    # it draws from the query's unified budget so
+                    # admit retries and execution retries share one
+                    # per-query pool instead of stacking
+                    if not recovery.retry_allowed("scheduler.admit"):
+                        raise recovery.RetryBudgetExhausted(
+                            "scheduler.admit",
+                            recovery.current_budget()) from e
                     continue
                 raise
         raise RuntimeError(

@@ -31,6 +31,7 @@ after a lost one, bounded by spark.task.maxFailures).
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -40,7 +41,55 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from spark_tpu import locks
 from spark_tpu import conf as CF
-from spark_tpu import faults, metrics, trace
+from spark_tpu import deadline, faults, metrics, recovery, trace
+
+SERVE_BREAKER_ENABLED = CF.register(
+    "spark.tpu.serve.breaker.enabled", True,
+    "Per-replica circuit breaker: a replica whose recent dispatch "
+    "failure rate crosses breaker.failureRate stops receiving traffic "
+    "(open) until a probe trickle (half-open) proves it healthy again.",
+    bool)
+SERVE_BREAKER_WINDOW_S = CF.register(
+    "spark.tpu.serve.breaker.windowSeconds", 30.0,
+    "Sliding window over which a replica's dispatch failure rate is "
+    "measured for the circuit breaker.", float)
+SERVE_BREAKER_MIN_REQUESTS = CF.register(
+    "spark.tpu.serve.breaker.minRequests", 5,
+    "Minimum dispatch outcomes inside the window before the breaker "
+    "will open (a single failure on a cold replica is not a rate).",
+    int)
+SERVE_BREAKER_FAILURE_RATE = CF.register(
+    "spark.tpu.serve.breaker.failureRate", 0.5,
+    "Windowed failure-rate threshold at which a replica's breaker "
+    "opens.", float)
+SERVE_BREAKER_OPEN_S = CF.register(
+    "spark.tpu.serve.breaker.openSeconds", 2.0,
+    "How long an open breaker blocks all traffic before admitting a "
+    "single half-open probe request.", float)
+
+SERVE_BROWNOUT_ENABLED = CF.register(
+    "spark.tpu.serve.brownout.enabled", True,
+    "Fleet-wide brownout: under sustained dispatch pressure the fleet "
+    "sheds analysis-heavy OPTIONAL work (trace sampling, compile "
+    "pre-warm, scan auto-cache promotion) before it sheds queries.",
+    bool)
+SERVE_BROWNOUT_WINDOW_S = CF.register(
+    "spark.tpu.serve.brownout.windowSeconds", 30.0,
+    "Sliding window over which fleet dispatch pressure (sheds + "
+    "failures as a fraction of outcomes) is measured.", float)
+SERVE_BROWNOUT_ENTER_RATE = CF.register(
+    "spark.tpu.serve.brownout.enterRate", 0.5,
+    "Windowed pressure at or above which the fleet enters brownout "
+    "level 1.", float)
+SERVE_BROWNOUT_EXIT_RATE = CF.register(
+    "spark.tpu.serve.brownout.exitRate", 0.1,
+    "Windowed pressure at or below which the fleet exits brownout "
+    "(hysteresis: between exitRate and enterRate the level holds).",
+    float)
+SERVE_BROWNOUT_MIN_EVENTS = CF.register(
+    "spark.tpu.serve.brownout.minEvents", 8,
+    "Minimum dispatch outcomes inside the window before the brownout "
+    "level may change.", int)
 
 #: response headers a replica sets that the router relays verbatim
 RELAY_HEADERS = ("X-Query-Id", "X-Queue-Wait-Ms", "X-Cache",
@@ -59,6 +108,235 @@ class NoHealthyReplica(RuntimeError):
     429 the client can retry after Retry-After)."""
 
 
+class CircuitBreaker:
+    """Per-replica closed/open/half-open breaker over a sliding window
+    of dispatch outcomes.
+
+    closed: outcomes accumulate in the window; when there are at least
+    ``breaker.minRequests`` of them and the failure fraction reaches
+    ``breaker.failureRate``, the breaker OPENS. open: all traffic is
+    refused for ``breaker.openSeconds``, then the next ``admits()``
+    moves to half-open. half-open: exactly ONE probe request is
+    admitted at a time (``begin()`` claims the slot); its success
+    CLOSES the breaker and clears the window, its failure re-OPENS it.
+    The router's health probe is orthogonal: the breaker measures real
+    dispatch outcomes, not /health reachability, so a replica that
+    answers /health but fails queries still trips."""
+
+    _MAX_TRANSITIONS = 32
+
+    def __init__(self, conf=None):
+        self._conf = conf
+        self._lock = locks.named_lock("serve.breaker")
+        #: replica id, for the breaker_transition metrics events
+        self.owner = ""
+        self.state = "closed"
+        self._window: collections.deque = collections.deque()
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._last_change: Optional[Tuple[str, str]] = None
+        #: bounded (ts, from, to) history — the chaos campaign asserts
+        #: open -> half_open -> closed recovery through this
+        self.state_changes: List[Tuple[float, str, str]] = []
+
+    def _param(self, entry, cast):
+        try:
+            return cast(self._conf.get(entry)) if self._conf is not None \
+                else cast(entry.default)
+        except Exception:
+            return cast(entry.default)
+
+    def _enabled(self) -> bool:
+        return self._param(SERVE_BREAKER_ENABLED, bool)
+
+    def _set_state(self, to: str) -> None:
+        if to == self.state:
+            return
+        self.state_changes.append((time.time(), self.state, to))
+        del self.state_changes[:-self._MAX_TRANSITIONS]
+        self._last_change = (self.state, to)
+        self.state = to
+
+    def _publish(self) -> None:
+        """Emit the latest transition as a metrics event — called by
+        the public methods AFTER releasing the breaker lock (metrics
+        takes its own registry lock; same outside-the-lock discipline
+        as the brownout controller)."""
+        with self._lock:
+            change, self._last_change = self._last_change, None
+        if change is None:
+            return
+        metrics.note_serve("breaker_transitions")
+        metrics.record("serve", phase="breaker_transition",
+                       replica=self.owner, from_state=change[0],
+                       to_state=change[1])
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self._param(SERVE_BREAKER_WINDOW_S, float)
+        w = self._window
+        while w and w[0][0] < horizon:
+            w.popleft()
+
+    def admits(self) -> bool:
+        """May this replica receive a request right now? (Transitions
+        open -> half_open once openSeconds have elapsed.)"""
+        if not self._enabled():
+            return True
+        with self._lock:
+            if self.state == "open":
+                open_s = self._param(SERVE_BREAKER_OPEN_S, float)
+                if time.time() - self._opened_at >= open_s:
+                    self._set_state("half_open")
+                    self._probe_inflight = False
+                else:
+                    return False
+            if self.state == "half_open":
+                result = not self._probe_inflight
+            else:
+                result = True
+        self._publish()
+        return result
+
+    def reset(self) -> None:
+        """Forget all window history and transitions and return to
+        closed — used between directed chaos scenarios so one
+        scenario's outcome mix does not skew the next one's rate."""
+        with self._lock:
+            self._window.clear()
+            self._probe_inflight = False
+            self.state = "closed"
+            self._last_change = None
+            del self.state_changes[:]
+
+    def begin(self) -> None:
+        """A request is about to be forwarded: in half-open this claims
+        the single probe slot."""
+        if not self._enabled():
+            return
+        with self._lock:
+            if self.state == "half_open":
+                self._probe_inflight = True
+
+    def success(self) -> None:
+        if not self._enabled():
+            return
+        with self._lock:
+            if self.state == "half_open":
+                # the probe proved the replica: full traffic resumes
+                # with a clean slate
+                self._set_state("closed")
+                self._window.clear()
+                self._probe_inflight = False
+            elif self.state == "closed":
+                now = time.time()
+                self._window.append((now, True))
+                self._prune(now)
+        self._publish()
+
+    def failure(self) -> None:
+        if not self._enabled():
+            return
+        with self._lock:
+            now = time.time()
+            if self.state == "half_open":
+                self._set_state("open")
+                self._opened_at = now
+                self._probe_inflight = False
+            elif self.state == "closed":
+                self._window.append((now, False))
+                self._prune(now)
+                total = len(self._window)
+                fails = sum(1 for _, ok in self._window if not ok)
+                if (total >= self._param(SERVE_BREAKER_MIN_REQUESTS,
+                                         int)
+                        and fails / total
+                        >= self._param(SERVE_BREAKER_FAILURE_RATE,
+                                       float)):
+                    self._set_state("open")
+                    self._opened_at = now
+                    self._window.clear()
+        self._publish()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = len(self._window)
+            fails = sum(1 for _, ok in self._window if not ok)
+            return {
+                "state": self.state,
+                "window_requests": total,
+                "window_failures": fails,
+                "state_changes": [
+                    {"at": ts, "from": a, "to": b}
+                    for ts, a, b in self.state_changes],
+            }
+
+
+class BrownoutController:
+    """Fleet-wide load-shedding level derived from dispatch outcomes.
+
+    Every dispatch outcome is noted as ``ok`` / ``shed`` (a 429 from a
+    saturated replica) / ``failure`` (replica death). When the windowed
+    pressure — (shed + failure) / total — reaches ``brownout.enterRate``
+    with at least ``brownout.minEvents`` outcomes, the fleet enters
+    level 1: OPTIONAL analysis-heavy work is shed before any query is
+    (trace/_sample_root stops sampling new traces, compile/service
+    skips pre-warm, io/datasource stops auto-cache promotion). Pressure
+    at or below ``brownout.exitRate`` exits; between the two rates the
+    level holds (hysteresis). The level is published through
+    ``metrics.set_brownout`` so those consumers need no reference to
+    the federation."""
+
+    def __init__(self, conf=None):
+        self._conf = conf
+        self._lock = locks.named_lock("serve.brownout")
+        self._window: collections.deque = collections.deque()
+        self.level = 0
+
+    def _param(self, entry, cast):
+        try:
+            return cast(self._conf.get(entry)) if self._conf is not None \
+                else cast(entry.default)
+        except Exception:
+            return cast(entry.default)
+
+    def note(self, kind: str) -> None:
+        """Record one dispatch outcome (``ok``/``shed``/``failure``)
+        and re-evaluate the level."""
+        if not self._param(SERVE_BROWNOUT_ENABLED, bool):
+            return
+        level = None
+        with self._lock:
+            now = time.time()
+            self._window.append((now, kind))
+            horizon = now - self._param(SERVE_BROWNOUT_WINDOW_S, float)
+            w = self._window
+            while w and w[0][0] < horizon:
+                w.popleft()
+            total = len(w)
+            if total >= self._param(SERVE_BROWNOUT_MIN_EVENTS, int):
+                pressure = sum(
+                    1 for _, k in w if k != "ok") / total
+                if self.level == 0 and pressure >= self._param(
+                        SERVE_BROWNOUT_ENTER_RATE, float):
+                    self.level = 1
+                    level = 1
+                elif self.level > 0 and pressure <= self._param(
+                        SERVE_BROWNOUT_EXIT_RATE, float):
+                    self.level = 0
+                    level = 0
+        if level is not None:
+            metrics.set_brownout(level)
+            metrics.record("serve", phase="brownout",
+                           level=level)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = len(self._window)
+            bad = sum(1 for _, k in self._window if k != "ok")
+            return {"level": self.level, "window_events": total,
+                    "window_pressure": (bad / total) if total else 0.0}
+
+
 class Replica:
     """One backend ConnectServer as the router sees it: URL, last
     probed load, and health."""
@@ -70,6 +348,7 @@ class Replica:
         self.queue_depth = 0
         self.running = 0
         self.last_probe = 0.0
+        self.breaker = CircuitBreaker()
 
     @property
     def load(self) -> int:
@@ -79,7 +358,8 @@ class Replica:
         return {"id": self.id, "url": self.url,
                 "healthy": self.healthy,
                 "queue_depth": self.queue_depth,
-                "running": self.running}
+                "running": self.running,
+                "breaker": self.breaker.snapshot()}
 
 
 def _as_replica(i: int, r) -> Replica:
@@ -108,6 +388,10 @@ class Federation:
         self.timeout = float(timeout)
         self._rr = 0
         self._lock = locks.named_lock("serve.federation")
+        for r in self.replicas:
+            r.breaker._conf = self._conf
+            r.breaker.owner = r.id
+        self.brownout = BrownoutController(self._conf)
 
     # -- health ---------------------------------------------------------------
 
@@ -135,6 +419,7 @@ class Federation:
                 rid = h.get("replica")
                 if rid:
                     r.id = str(rid)
+                    r.breaker.owner = r.id
             except Exception:
                 r.healthy = False
             r.last_probe = time.time()
@@ -159,6 +444,13 @@ class Federation:
         pool = [r for r in self.healthy() if r.id not in set(exclude)]
         if not pool:
             return None
+        # breaker filtering is advisory: when every candidate's breaker
+        # refuses (e.g. the whole fleet just flapped), fall back to the
+        # unfiltered pool — an attempt against a maybe-bad replica
+        # beats refusing a request the fleet could still serve
+        admitted = [r for r in pool if r.breaker.admits()]
+        if admitted:
+            pool = admitted
         if affinity:
             for r in pool:
                 if r.id == affinity:
@@ -236,6 +528,7 @@ class Federation:
         last_err: Optional[BaseException] = None
         shed = False
         for attempt in range(retries + len(self.replicas) + 1):
+            deadline.check("serve.dispatch")
             self.probe()
             r = self.pick(affinity=affinity,
                           exclude=exhausted | dead,
@@ -243,6 +536,7 @@ class Federation:
             affinity = None  # only honored for the first choice
             if r is None:
                 break
+            r.breaker.begin()
             metrics.note_serve("dispatches")
             metrics.record("serve", phase="dispatch", replica=r.id,
                            path=path)
@@ -261,14 +555,18 @@ class Federation:
                         r, method, path, body, hdrs)
             except _CONN_ERRORS as e:
                 last_err = e
+                r.breaker.failure()
+                self.brownout.note("failure")
                 r.healthy = False
                 dead.add(r.id)
                 if len(dead) > retries:
                     break
                 metrics.note_serve("replica_failures")
-                metrics.note_serve("redispatches")
                 metrics.record("serve", phase="replica_down",
                                replica=r.id, error=type(e).__name__)
+                if not recovery.retry_allowed("serve.dispatch"):
+                    break
+                metrics.note_serve("redispatches")
                 metrics.record("serve", phase="redispatch",
                                replica=r.id)
                 continue
@@ -278,20 +576,28 @@ class Federation:
                     raise  # corrupt/oom: surface typed, no retry
                 # injected replica death mid-query: same recovery as a
                 # real connection failure
+                r.breaker.failure()
+                self.brownout.note("failure")
                 r.healthy = False
                 dead.add(r.id)
                 if len(dead) > retries:
                     break
                 metrics.note_serve("replica_failures")
-                metrics.note_serve("redispatches")
                 metrics.record("serve", phase="replica_down",
                                replica=r.id, error=type(e).__name__)
+                if not recovery.retry_allowed("serve.dispatch"):
+                    break
+                metrics.note_serve("redispatches")
                 metrics.record("serve", phase="redispatch",
                                replica=r.id)
                 continue
             if code == 429:
                 # admission shedding: this replica's scheduler is
-                # full — take the request to the emptiest other queue
+                # full — take the request to the emptiest other queue.
+                # the replica ANSWERED, so its breaker records a
+                # success; the fleet-wide brownout records the shed
+                r.breaker.success()
+                self.brownout.note("shed")
                 exhausted.add(r.id)
                 try:
                     detail = json.loads(data)
@@ -305,6 +611,8 @@ class Federation:
                 metrics.record("serve", phase="shed", replica=r.id,
                                retry_after_s=ra)
                 continue
+            r.breaker.success()
+            self.brownout.note("ok")
             return code, data, hdr
         if retry_afters:
             # ALL healthy replicas saturated: now (and only now) the

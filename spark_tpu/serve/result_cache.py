@@ -35,7 +35,7 @@ import pyarrow as pa
 
 from spark_tpu import locks
 from spark_tpu import conf as CF
-from spark_tpu import metrics
+from spark_tpu import deadline, metrics
 from spark_tpu.storage.lru import LruDict
 
 #: follower wait bound per round: the owner always sets the flight
@@ -189,10 +189,18 @@ class ResultCache:
                 metrics.record("serve_cache", phase="miss", key=kd,
                                bytes=len(blob))
                 return blob, "miss"
-            # follower: block on the owner's flight
+            # follower: block on the owner's flight — never past this
+            # caller's own deadline (the owner keeps computing for ITS
+            # caller; this follower's window closing is follower-local)
+            deadline.check("result_cache.wait")
             metrics.note_serve("waits")
             t0 = time.perf_counter()
-            if not fl.event.wait(timeout=_FLIGHT_WAIT_S):
+            wait_s = _FLIGHT_WAIT_S
+            rem = deadline.remaining()
+            if rem is not None:
+                wait_s = max(0.0, min(wait_s, rem))
+            if not fl.event.wait(timeout=wait_s):
+                deadline.check("result_cache.wait")
                 # the owner exceeded the flight bound without
                 # publishing a result or an error: surface the typed
                 # timeout and execute independently rather than wait

@@ -30,13 +30,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
 from spark_tpu import conf as CF
-from spark_tpu import trace
+from spark_tpu import deadline, metrics, recovery, trace
 from spark_tpu.serve.federation import Federation, NoHealthyReplica
 
 #: request headers the router forwards to the chosen replica
 #: (X-SparkTpu-Trace is a passthrough fallback — Federation.dispatch
-#: rewrites it per forward attempt so replica spans parent correctly)
-_FORWARD_HEADERS = ("Content-Type", "X-Spark-Pool", trace.TRACE_HEADER)
+#: rewrites it per forward attempt so replica spans parent correctly;
+#: X-SparkTpu-Deadline is an ABSOLUTE timestamp, forwarded verbatim so
+#: the replica's scheduler/retry seams observe the client's window)
+_FORWARD_HEADERS = ("Content-Type", "X-Spark-Pool", trace.TRACE_HEADER,
+                    deadline.DEADLINE_HEADER)
 
 
 class FederationRouter:
@@ -75,10 +78,17 @@ class FederationRouter:
                        if self.headers.get(k)}
                 affinity = self.headers.get("X-SparkTpu-Replica")
                 # adopt the client's trace so router.dispatch /
-                # router.forward spans join it (a fresh root otherwise)
+                # router.forward spans join it (a fresh root otherwise);
+                # bind the client's deadline so the dispatch loop's own
+                # re-dispatch attempts stop when the window closes, and
+                # a per-request retry budget so re-dispatches draw from
+                # the same unified pool as every other layer
                 rctx = trace.from_header(
                     self.headers.get(trace.TRACE_HEADER))
-                with trace.attach(rctx):
+                rdl = deadline.from_header(
+                    self.headers.get(deadline.DEADLINE_HEADER))
+                with trace.attach(rctx), deadline.bind(rdl), \
+                        recovery.bind_default_budget(outer.conf):
                     self._dispatch_traced(method, body, fwd, affinity)
 
             def _dispatch_traced(self, method: str, body, fwd,
@@ -87,6 +97,12 @@ class FederationRouter:
                     code, data, hdr = outer.federation.dispatch(
                         method, self.path, body, headers=fwd,
                         affinity=affinity)
+                except deadline.DeadlineExceeded as e:
+                    self._send(504, json.dumps(
+                        {"error": "DeadlineExceeded",
+                         "message": str(e)}).encode(),
+                        "application/json")
+                    return
                 except NoHealthyReplica as e:
                     self._send(503, json.dumps(
                         {"error": "NoHealthyReplica",
@@ -113,7 +129,11 @@ class FederationRouter:
                         "status": "ok" if ok else "degraded",
                         "router": True,
                         "policy": str(outer.conf.get(CF.SERVE_POLICY)),
-                        "replicas": reps}).encode()
+                        "replicas": reps,
+                        "brownout":
+                            outer.federation.brownout.snapshot(),
+                        "retry_budget":
+                            metrics.retry_budget_stats()}).encode()
                     self._send(200, body, "application/json")
                     return
                 if self.path == "/tables" \

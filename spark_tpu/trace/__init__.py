@@ -128,6 +128,10 @@ def _sample_root() -> bool:
         enabled, ratio = True, 1.0
     if not enabled or ratio <= 0.0:
         return False
+    if metrics.brownout_level() > 0:
+        # fleet brownout sheds NEW trace sampling before any query:
+        # in-flight traces finish, fresh roots go unsampled
+        return False
     return ratio >= 1.0 or random.random() < ratio
 
 

@@ -573,13 +573,19 @@ def serve_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
             rid = str(e.get("replica", "?"))
             rec = replicas.setdefault(rid, {
                 "dispatched": 0, "shed": 0, "redispatched": 0,
-                "failed": 0})
+                "failed": 0, "breaker_transitions": 0})
             phase = e.get("phase")
             key = {"dispatch": "dispatched", "shed": "shed",
                    "redispatch": "redispatched",
-                   "replica_down": "failed"}.get(phase)
+                   "replica_down": "failed",
+                   "breaker_transition": "breaker_transitions",
+                   }.get(phase)
             if key is not None:
                 rec[key] += 1
+            if phase == "breaker_transition":
+                # events arrive oldest-first, so the last one seen is
+                # the replica's latest known breaker state
+                rec["breaker_state"] = str(e.get("to_state", "?"))
         elif kind == "serve_cache":
             phase = e.get("phase")
             if phase in cache:
@@ -588,7 +594,9 @@ def serve_profile(events: Optional[List[dict]] = None) -> Dict[str, dict]:
                 cache["execute_ms"] = round(
                     cache["execute_ms"] + float(e.get("ms", 0.0)), 3)
     return {"replicas": replicas, "cache": cache,
-            "totals": metrics.serve_stats()}
+            "totals": metrics.serve_stats(),
+            "resilience": {"brownout": metrics.brownout_stats(),
+                           "retry_budget": metrics.retry_budget_stats()}}
 
 
 def format_serve_profile(profile: Optional[Dict[str, dict]] = None) -> str:
@@ -608,12 +616,24 @@ def format_serve_profile(profile: Optional[Dict[str, dict]] = None) -> str:
         f"re-dispatches, {t.get('rejected', 0)} rejected "
         f"(all saturated), {t.get('replica_failures', 0)} replica "
         "failures"]
+    res = p.get("resilience", {})
+    if res:
+        bo = res.get("brownout", {})
+        rb = res.get("retry_budget", {})
+        lines.append(
+            f"resilience: brownout level {bo.get('level', 0)} "
+            f"({bo.get('entered', 0)} entered/{bo.get('exited', 0)} "
+            f"exited), retry budget {rb.get('draws', 0)} draws "
+            f"({rb.get('floor_draws', 0)} floored, "
+            f"{rb.get('denials', 0)} denied, "
+            f"{rb.get('exhaustions', 0)} exhausted)")
     if p.get("replicas"):
-        lines.append("replica       disp shed redisp fail")
+        lines.append("replica       disp shed redisp fail breaker")
         for rid, rec in sorted(p["replicas"].items()):
             lines.append(
                 f"{rid:<12} {rec['dispatched']:>5} {rec['shed']:>4} "
-                f"{rec['redispatched']:>6} {rec['failed']:>4}")
+                f"{rec['redispatched']:>6} {rec['failed']:>4} "
+                f"{rec.get('breaker_state', 'closed')}")
     return "\n".join(lines)
 
 

@@ -107,6 +107,12 @@ def pytest_configure(config):
         "trace: end-to-end query tracing (spark_tpu/trace/) — "
         "hierarchical spans, cross-replica context propagation, "
         "Perfetto export, overhead guard")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded chaos-campaign harness (spark_tpu/chaos.py) — "
+        "randomized multi-point fault schedules asserting "
+        "byte-identical-or-typed-error, zero hangs, attempts within "
+        "the unified retry budget")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -116,7 +122,8 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if ("compile" in item.keywords or "serve" in item.keywords
                 or "mview" in item.keywords or "agg" in item.keywords
-                or "trace" in item.keywords) \
+                or "trace" in item.keywords
+                or "chaos" in item.keywords) \
                 and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):

@@ -1,0 +1,94 @@
+"""Fast chaos-campaign smoke (spark_tpu/chaos.py) — three seeded
+multi-point schedules through a live two-replica fleet, asserting the
+full resilience contract on each: byte-identical-or-typed-error, zero
+hangs, attempts within the unified retry budget, and the HBM
+invariant. The 25-schedule campaign (kill-one-replica, A/B attempts)
+lives in tools/chaos_campaign.py; this marker-gated smoke keeps the
+contract under tier-1 without its runtime.
+"""
+
+import json
+import urllib.request
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_tpu import chaos, faults, metrics
+from spark_tpu.connect.server import Client
+from spark_tpu.serve.router import serve_fleet
+
+pytestmark = [pytest.mark.chaos, pytest.mark.timeout(240)]
+
+_SMOKE_QUERIES = (
+    "SELECT a, b FROM chaos_t WHERE a >= 8",
+    "SELECT a % 4 AS g, SUM(b) AS s FROM chaos_t GROUP BY a % 4",
+)
+
+
+@pytest.fixture
+def fleet(spark, tmp_path):
+    path = str(tmp_path / "chaos_t.parquet")
+    pq.write_table(pa.table({
+        "a": list(range(64)),
+        "b": [float(i) * 0.5 for i in range(64)]}), path)
+    spark.read.parquet(path).createOrReplaceTempView("chaos_t")
+    fl = serve_fleet(spark, replicas=2)
+    try:
+        yield fl
+    finally:
+        fl.stop()
+        for k in list(spark.conf._overrides):
+            if k.startswith("spark.tpu.faultInjection"):
+                spark.conf.unset(k)
+        faults.reset(spark.conf)
+        rc = getattr(spark, "serve_result_cache", None)
+        if rc is not None:
+            rc.clear()
+        metrics.reset_brownout()
+
+
+def _workload(spark, url):
+    rc = getattr(spark, "serve_result_cache", None)
+    if rc is not None:
+        rc.clear()  # faults must reach the engine, not a cached blob
+    client = Client(url, timeout=20.0, retries=3)
+    return b"\x00".join(
+        json.dumps(client.sql(q).to_pydict(),
+                   sort_keys=True).encode()
+        for q in _SMOKE_QUERIES)
+
+
+def test_chaos_smoke_three_schedules(spark, fleet):
+    clean = _workload(spark, fleet.url)
+    schedules = chaos.generate_campaign(7, 3)
+    report = chaos.run_campaign(
+        spark.conf, lambda: _workload(spark, fleet.url), schedules,
+        clean_bytes=clean, alarm_s=60.0,
+        queries=len(_SMOKE_QUERIES),
+        memory_manager=spark.memory_manager)
+    assert report.ok, [r.to_dict() for r in report.failures]
+    assert len(report.results) == 3
+    for r in report.results:
+        assert r.outcome in ("identical", "typed_error")
+        assert r.elapsed_s < 60.0  # zero hangs
+
+
+def test_chaos_replay_artifact_roundtrip(tmp_path):
+    sch = chaos.generate_campaign(3, 2)[1]
+    art = tmp_path / "fail.json"
+    art.write_text(json.dumps(
+        {"schedule": sch.to_dict(), "ok": False,
+         "outcome": "mismatch"}))
+    assert chaos.replay_artifact(str(art)) == sch
+
+
+def test_router_health_reports_resilience(spark, fleet):
+    with urllib.request.urlopen(fleet.url + "/health",
+                                timeout=10.0) as resp:
+        h = json.loads(resp.read())
+    assert "brownout" in h and "level" in h["brownout"]
+    assert "retry_budget" in h and "draws" in h["retry_budget"]
+    for rep in h["replicas"]:
+        assert rep["breaker"]["state"] in ("closed", "open",
+                                           "half_open")

@@ -1,0 +1,556 @@
+"""Fleet-grade resilience primitives: end-to-end deadline propagation
+(spark_tpu/deadline.py), the unified per-query retry budget
+(recovery.RetryBudget), the per-replica circuit breaker + fleet
+brownout (serve/federation.py), per-point fault RNG isolation, and the
+retry-budget lint rule.
+
+Every test carries the ``timeout`` deadlock guard — a deadline that
+fails to fire must fail the test, never hang tier-1.
+"""
+
+import ast
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from spark_tpu import chaos, deadline, faults, metrics, recovery, tracing
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.serve.federation import BrownoutController, CircuitBreaker
+
+pytestmark = pytest.mark.timeout(90)
+
+
+# ---- deadline propagation ---------------------------------------------------
+
+
+def test_deadline_mint_bind_remaining():
+    assert deadline.current() is None
+    assert deadline.remaining() is None
+    assert not deadline.expired()
+    dl = deadline.mint(5.0)
+    with deadline.bind(dl):
+        assert deadline.current() == dl
+        rem = deadline.remaining()
+        assert 0.0 < rem <= 5.0
+        assert not deadline.expired()
+        deadline.check("test")  # no raise
+    assert deadline.current() is None
+
+
+def test_deadline_mint_none_for_nonpositive():
+    assert deadline.mint(None) is None
+    assert deadline.mint(0.0) is None
+    assert deadline.mint(-3.0) is None
+
+
+def test_deadline_tighter_ambient_wins():
+    outer = deadline.mint(100.0)
+    inner = deadline.mint(1.0)
+    with deadline.bind(outer):
+        with deadline.bind(inner):
+            assert deadline.current() == min(outer, inner) == inner
+        # a LOOSER inner bind cannot extend the outer window
+        with deadline.bind(deadline.mint(500.0)):
+            assert deadline.current() == outer
+        assert deadline.current() == outer
+
+
+def test_deadline_check_raises_typed():
+    with deadline.bind(time.time() - 0.01):
+        assert deadline.expired()
+        with pytest.raises(deadline.DeadlineExceeded,
+                           match="DEADLINE_EXCEEDED at somewhere"):
+            deadline.check("somewhere")
+
+
+def test_deadline_cap_sleep():
+    assert deadline.cap_sleep(3.0) == 3.0  # unbound: unchanged
+    with deadline.bind(time.time() + 0.2):
+        assert deadline.cap_sleep(10.0) <= 0.2
+        assert deadline.cap_sleep(0.05) == pytest.approx(0.05, abs=0.01)
+    with deadline.bind(time.time() - 1.0):
+        assert deadline.cap_sleep(10.0) == 0.0
+
+
+def test_deadline_header_roundtrip():
+    dl = time.time() + 12.5
+    with deadline.bind(dl):
+        hv = deadline.header_value()
+    assert hv is not None
+    back = deadline.from_header(hv)
+    assert back == pytest.approx(dl, abs=1e-3)
+    assert deadline.from_header(None) is None
+    assert deadline.from_header("garbage") is None
+
+
+def test_deadline_exceeded_not_transient():
+    """The typed deadline error must NOT be re-retried by outer layers
+    even though its message carries the DEADLINE_EXCEEDED marker."""
+    e = deadline.DeadlineExceeded("layer", time.time() - 1.0)
+    assert "DEADLINE_EXCEEDED" in str(e)
+    assert not recovery.is_transient(e)
+    # ... even when wrapped as a cause of a generic error
+    wrapper = RuntimeError("stage failed")
+    wrapper.__cause__ = e
+    assert not recovery.is_transient(wrapper)
+
+
+# ---- unified retry budget ---------------------------------------------------
+
+
+def test_retry_budget_pool_shared_across_layers():
+    b = recovery.RetryBudget(4, layer_floor=0)
+    granted = sum(b.draw("a") for _ in range(3))
+    granted += sum(b.draw("b") for _ in range(3))
+    assert granted == 4  # ONE pool, not 3 per layer
+    assert b.draw("c") is False
+    snap = b.snapshot()
+    assert snap["remaining"] == 0
+    assert snap["draws"] == 4
+    assert set(snap["layers"]) == {"a", "b"}
+
+
+def test_retry_budget_layer_floor():
+    """An exhausted pool still grants each layer its floor so one noisy
+    layer cannot starve every other layer's FIRST retry."""
+    b = recovery.RetryBudget(2, layer_floor=1)
+    assert b.draw("noisy") and b.draw("noisy")
+    assert not b.draw("noisy")  # pool gone, floor already used
+    assert b.draw("quiet")      # floor guarantee for a fresh layer
+    assert not b.draw("quiet")
+
+
+def test_retry_budget_exhausted_typed_and_not_transient():
+    b = recovery.RetryBudget(1)
+    b.draw("x")
+    err = recovery.RetryBudgetExhausted("x", b)
+    assert "RETRY_BUDGET_EXHAUSTED" in str(err)
+    assert not recovery.is_transient(err)
+
+
+def test_retry_budget_metrics_events():
+    metrics.reset_retry_budget()
+    b = recovery.RetryBudget(2, layer_floor=0)
+    b.draw("layer1")
+    b.draw("layer1")
+    b.draw("layer1")  # denied
+    st = metrics.retry_budget_stats()
+    assert st["draws"] == 2
+    assert st["denials"] == 1
+    evs = [e for e in metrics.recent(64)
+           if e["kind"] == "retry_draw" and e["layer"] == "layer1"]
+    assert len(evs) == 3  # every draw (granted or denied) is an event
+    assert [e["granted"] for e in evs] == [True, True, False]
+
+
+def test_retry_allowed_legacy_counter_without_budget():
+    """No ambient budget -> the seam allows the retry but counts it as
+    a legacy attempt (the A/B counter for the campaign)."""
+    metrics.reset_retry_budget()
+    assert recovery.current_budget() is None
+    assert recovery.retry_allowed("anything") is True
+    assert metrics.retry_budget_stats()["legacy_attempts"] == 1
+
+
+def test_budget_from_conf_and_binding():
+    conf = RuntimeConf({"spark.tpu.recovery.retryBudget.attempts": 3})
+    b = recovery.budget_from_conf(conf)
+    assert b is not None and b.snapshot()["attempts"] == 3
+    with recovery.bind_budget(b):
+        assert recovery.current_budget() is b
+        assert recovery.retry_allowed("seam") is True
+    assert recovery.current_budget() is None
+    off = RuntimeConf(
+        {"spark.tpu.recovery.retryBudget.enabled": False})
+    assert recovery.budget_from_conf(off) is None
+
+
+def test_backoff_sleep_capped_by_deadline():
+    b = recovery.RetryBudget(4, backoff_base_s=50.0, backoff_cap_s=50.0)
+    with deadline.bind(time.time() + 0.15):
+        t0 = time.perf_counter()
+        b.sleep(3)  # uncapped this would be tens of seconds
+        assert time.perf_counter() - t0 < 1.0
+
+
+# ---- client fail-fast (satellite 1) -----------------------------------------
+
+
+class _Always429(BaseHTTPRequestHandler):
+    """A server whose Retry-After hint (10s) far exceeds any sane
+    client timeout — the old client slept through its own deadline."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        self.rfile.read(n)
+        body = json.dumps({"error": "SchedulerQueueFull",
+                           "message": "full", "retry_after_s": 10.0}
+                          ).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", "10")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_client_never_sleeps_past_its_deadline():
+    from spark_tpu.connect.server import Client
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Always429)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        client = Client(url, timeout=0.8, retries=8)
+        t0 = time.perf_counter()
+        with pytest.raises(deadline.DeadlineExceeded):
+            client.sql("SELECT 1")
+        elapsed = time.perf_counter() - t0
+        # one 10s Retry-After floor would already blow this bound
+        assert elapsed < 5.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5.0)
+
+
+# ---- circuit breaker --------------------------------------------------------
+
+
+def _breaker(**over):
+    base = {"spark.tpu.serve.breaker.minRequests": 2,
+            "spark.tpu.serve.breaker.openSeconds": 0.05,
+            "spark.tpu.serve.breaker.failureRate": 0.5}
+    base.update(over)
+    return CircuitBreaker(RuntimeConf(base))
+
+
+def test_breaker_opens_on_failure_rate():
+    br = _breaker()
+    assert br.admits()
+    br.failure()
+    assert br.state == "closed"  # below minRequests
+    br.failure()
+    assert br.state == "open"
+    assert not br.admits()
+
+
+def test_breaker_half_open_probe_then_close():
+    br = _breaker()
+    br.failure()
+    br.failure()
+    assert br.state == "open"
+    time.sleep(0.06)
+    assert br.admits()  # transitions to half_open
+    assert br.state == "half_open"
+    br.begin()
+    assert not br.admits()  # single probe trickle
+    br.success()
+    assert br.state == "closed"
+    assert br.admits()
+    transitions = [(a, b) for _, a, b in br.state_changes]
+    assert transitions == [("closed", "open"), ("open", "half_open"),
+                           ("half_open", "closed")]
+
+
+def test_breaker_half_open_failure_reopens():
+    br = _breaker()
+    br.failure()
+    br.failure()
+    time.sleep(0.06)
+    assert br.admits()
+    br.begin()
+    br.failure()
+    assert br.state == "open"
+    assert not br.admits()
+
+
+def test_breaker_successes_keep_rate_low():
+    br = _breaker()
+    for _ in range(8):
+        br.success()
+    br.failure()
+    br.failure()
+    # 2 failures / 10 outcomes = 0.2 < 0.5 threshold
+    assert br.state == "closed"
+
+
+def test_breaker_disabled_is_transparent():
+    br = CircuitBreaker(RuntimeConf(
+        {"spark.tpu.serve.breaker.enabled": False}))
+    for _ in range(10):
+        br.failure()
+    assert br.state == "closed" and br.admits()
+
+
+# ---- brownout ---------------------------------------------------------------
+
+
+def test_brownout_enters_and_exits_with_hysteresis():
+    metrics.reset_brownout()
+    bo = BrownoutController(RuntimeConf({
+        "spark.tpu.serve.brownout.minEvents": 4,
+        "spark.tpu.serve.brownout.enterRate": 0.5,
+        "spark.tpu.serve.brownout.exitRate": 0.1}))
+    try:
+        for _ in range(4):
+            bo.note("failure")
+        assert bo.level == 1
+        assert metrics.brownout_level() == 1
+        # pressure between exit and enter rate: level HOLDS
+        for _ in range(4):
+            bo.note("ok")
+        assert bo.level == 1
+        for _ in range(32):
+            bo.note("ok")
+        assert bo.level == 0
+        assert metrics.brownout_level() == 0
+        st = metrics.brownout_stats()
+        assert st["entered"] == 1 and st["exited"] == 1
+    finally:
+        metrics.reset_brownout()
+
+
+def test_brownout_sheds_trace_sampling_and_prewarm():
+    from spark_tpu import trace as trace_mod
+
+    metrics.reset_brownout()
+    try:
+        metrics.set_brownout(1)
+        assert trace_mod._sample_root() is False
+    finally:
+        metrics.reset_brownout()
+
+
+def test_serve_profile_reports_resilience():
+    p = tracing.serve_profile(events=[])
+    assert "resilience" in p
+    assert set(p["resilience"]) == {"brownout", "retry_budget"}
+
+
+# ---- per-point fault RNG isolation (satellite 2) ----------------------------
+
+
+def _fire_pattern(conf, point, n):
+    pat = []
+    for _ in range(n):
+        try:
+            faults.inject(point, conf)
+            pat.append(False)
+        except faults.InjectedFault:
+            pat.append(True)
+    return pat
+
+
+def test_prob_fault_streams_isolated_per_point():
+    """One point's arrival count must never perturb another's draw
+    sequence: the pattern for point A is identical whether or not
+    point B is armed and firing between A's arrivals."""
+    spec = "prob:0.5:424242"
+    key_a = "spark.tpu.faultInjection.execute.device"
+    key_b = "spark.tpu.faultInjection.scheduler.admit"
+    alone = _fire_pattern(
+        RuntimeConf({key_a: spec}), "execute.device", 40)
+    conf = RuntimeConf({key_a: spec, key_b: spec})
+    mixed = []
+    for i in range(40):
+        try:
+            faults.inject("execute.device", conf)
+            mixed.append(False)
+        except faults.InjectedFault:
+            mixed.append(True)
+        try:
+            faults.inject("scheduler.admit", conf)
+        except faults.InjectedFault:
+            pass
+    assert mixed == alone
+    assert 0 < sum(alone) < 40  # the stream actually fires sometimes
+
+
+def test_prob_fault_streams_differ_between_points():
+    """Same campaign seed, different points -> DECORRELATED streams
+    (the old shared-seed bug made every point fire in lockstep)."""
+    spec = "prob:0.5:777"
+    pat_a = _fire_pattern(RuntimeConf(
+        {"spark.tpu.faultInjection.execute.device": spec}),
+        "execute.device", 64)
+    pat_b = _fire_pattern(RuntimeConf(
+        {"spark.tpu.faultInjection.scheduler.admit": spec}),
+        "scheduler.admit", 64)
+    assert pat_a != pat_b
+
+
+# ---- deadline expiry while QUEUED (satellite 3) -----------------------------
+
+
+class _MiniSession:
+    """Duck-typed session: conf + unified memory manager, nothing else
+    (the scheduler only reads those two)."""
+
+    def __init__(self, conf, mm):
+        self.conf = conf
+        self.memory_manager = mm
+
+
+def test_deadline_expired_in_queue_zero_executions_zero_grants():
+    from spark_tpu.scheduler import QueryCancelled, QueryScheduler
+    from spark_tpu.storage.unified import UnifiedMemoryManager
+
+    conf = RuntimeConf({"spark.tpu.scheduler.maxConcurrency": 1})
+    mm = UnifiedMemoryManager(budget_bytes=1 << 24, conf=conf)
+    sched = QueryScheduler(_MiniSession(conf, mm))
+    release = threading.Event()
+    ran = threading.Event()
+    try:
+        blocker = sched.submit(lambda tk: release.wait(30))
+        t0 = time.time() + 30
+        while blocker.state != "RUNNING" and time.time() < t0:
+            time.sleep(0.005)
+        grants_before = mm.snapshot()["grants"]["grants"]
+
+        def work(tk):
+            ran.set()
+            return "late"
+
+        t = sched.submit(work, deadline_s=0.05)
+        time.sleep(0.1)  # deadline passes while QUEUED behind blocker
+        release.set()
+        with pytest.raises(QueryCancelled, match="DEADLINE_EXCEEDED"):
+            t.result(timeout=30)
+        blocker.result(timeout=30)
+        assert not ran.is_set()  # ZERO device executions
+        snap = mm.snapshot()
+        assert snap["grants"]["grants"] == grants_before  # ZERO grants
+        assert snap["in_use_bytes"] == 0
+        assert (snap["in_use_bytes"] + snap["storage_bytes"]
+                <= snap["budget_bytes"])
+    finally:
+        release.set()
+        sched.stop()
+
+
+# ---- scheduler merges the propagated deadline -------------------------------
+
+
+def test_scheduler_submit_merges_ambient_deadline():
+    from spark_tpu.scheduler import QueryScheduler
+
+    sched = QueryScheduler(conf=RuntimeConf())
+    try:
+        tight = time.time() + 0.5
+        with deadline.bind(tight):
+            t = sched.submit(lambda tk: "ok", deadline_s=600.0)
+        assert t.deadline == pytest.approx(tight, abs=1e-6)
+        assert t.result(timeout=30) == "ok"
+    finally:
+        sched.stop()
+
+
+# ---- lint rule 7: retry loops draw from the budget --------------------------
+
+
+_VIOLATION = '''
+def retry_without_budget(fn, retries):
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception:
+            continue
+'''
+
+_CLEAN = '''
+def retry_with_budget(fn, retries):
+    from spark_tpu import recovery
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception:
+            if not recovery.retry_allowed("seam"):
+                raise
+'''
+
+_NOT_A_RETRY = '''
+def plain_loop(items):
+    for i in range(len(items)):
+        items[i] += 1
+'''
+
+
+def test_lint_rule7_flags_unbudgeted_retry_loop():
+    from tools.lint_invariants import DEFAULT_CONFIG, _check_retry_budget
+
+    out = []
+    _check_retry_budget(ast.parse(_VIOLATION), "x.py",
+                        dict(DEFAULT_CONFIG), out)
+    assert len(out) == 1 and out[0].rule == "retry-budget"
+    out = []
+    _check_retry_budget(ast.parse(_CLEAN), "x.py",
+                        dict(DEFAULT_CONFIG), out)
+    assert out == []
+    out = []
+    _check_retry_budget(ast.parse(_NOT_A_RETRY), "x.py",
+                        dict(DEFAULT_CONFIG), out)
+    assert out == []
+
+
+def test_lint_rule7_exemption():
+    from tools.lint_invariants import DEFAULT_CONFIG, _check_retry_budget
+
+    cfg = dict(DEFAULT_CONFIG)
+    cfg["retry_loop_allow"] = ["x.py:retry_without_budget"]
+    out = []
+    _check_retry_budget(ast.parse(_VIOLATION), "x.py", cfg, out)
+    assert out == []
+
+
+def test_lint_clean_tree():
+    """The converted tree passes rule 7 (and every other rule)."""
+    from tools.lint_invariants import run_lint
+
+    assert [f.format() for f in run_lint()] == []
+
+
+# ---- chaos harness units ----------------------------------------------------
+
+
+def test_campaign_generation_deterministic():
+    a = chaos.generate_campaign(99, 10)
+    b = chaos.generate_campaign(99, 10)
+    assert [s.to_dict() for s in a] == [s.to_dict() for s in b]
+    c = chaos.generate_campaign(100, 10)
+    assert [s.to_dict() for s in a] != [s.to_dict() for s in c]
+    for s in a:
+        assert 1 <= len(s.faults) <= 3
+        for f in s.faults:
+            assert f.point in faults.POINTS
+            assert f.kind in faults.KINDS
+            faults.parse_spec(f.spec())  # grammar round-trip
+
+
+def test_chaos_schedule_json_roundtrip():
+    sch = chaos.generate_campaign(5, 3)[2]
+    back = chaos.ChaosSchedule.from_dict(
+        json.loads(json.dumps(sch.to_dict())))
+    assert back == sch
+
+
+def test_is_typed_error_classification():
+    assert chaos.is_typed_error(
+        faults.InjectedTransientError("p", "UNAVAILABLE: x"))
+    assert chaos.is_typed_error(
+        deadline.DeadlineExceeded("w", time.time()))
+    assert chaos.is_typed_error(
+        recovery.RetryBudgetExhausted("l", None))
+    assert chaos.is_typed_error(RuntimeError("DEADLINE_EXCEEDED: t/o"))
+    wrapped = RuntimeError("stage failed")
+    wrapped.__cause__ = faults.InjectedCorruptionError("p", "DATA_LOSS")
+    assert chaos.is_typed_error(wrapped)
+    assert not chaos.is_typed_error(AttributeError("oops"))
+    assert not chaos.is_typed_error(RuntimeError("segfault adjacent"))

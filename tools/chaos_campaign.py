@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Seeded chaos campaign over a live in-process serving fleet.
+
+Drives the full stack — Client -> FederationRouter -> replica
+ConnectServers -> scheduler -> engine — through randomized multi-point
+fault schedules (spark_tpu/chaos.py), asserting the fleet-grade
+resilience contract on every one: byte-identical-or-typed-error, zero
+hangs, retry attempts bounded by the unified budget, and the HBM
+invariant ``execution + storage <= budget``. Also runs two directed
+scenarios the random sweep can't guarantee to hit:
+
+- **kill-one-replica**: stop a replica's HTTP server mid-campaign,
+  watch its circuit breaker open on the dispatch failure, revive the
+  replica on the SAME port, and assert the breaker walks
+  open -> half_open -> closed as the probe request succeeds.
+- **A/B attempts**: the same fault-heavy schedule with the unified
+  retry budget DISABLED (legacy multiplicative per-layer caps) vs
+  ENABLED, comparing total attempt draws.
+
+Usage:
+  python tools/chaos_campaign.py --seed 7 --schedules 25
+  python tools/chaos_campaign.py --replay /tmp/chaos_fail.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pyarrow as pa  # noqa: E402
+import pyarrow.parquet as pq  # noqa: E402
+
+from spark_tpu import chaos, faults, metrics  # noqa: E402
+from spark_tpu import recovery  # noqa: E402
+from spark_tpu.connect.server import Client, ConnectServer  # noqa: E402
+from spark_tpu.serve.router import serve_fleet  # noqa: E402
+
+#: the mixed workload: scan+filter, aggregation, and a join — together
+#: they cross every engine-side injection point the campaign arms
+_QUERIES = (
+    "SELECT a, b FROM t WHERE a >= 8",
+    "SELECT a % 4 AS g, SUM(b) AS s, COUNT(*) AS n FROM t "
+    "GROUP BY a % 4",
+    "SELECT t.a, t.b, u.c FROM t JOIN u ON t.a = u.a WHERE u.c < 40",
+)
+
+
+def _make_session(tmp):
+    from spark_tpu.api.session import SparkSession
+
+    sess = SparkSession.builder.getOrCreate()
+    t = pa.table({"a": list(range(96)),
+                  "b": [float(i) * 0.5 for i in range(96)]})
+    u = pa.table({"a": list(range(0, 96, 2)),
+                  "c": [i % 48 for i in range(48)]})
+    pt, pu = os.path.join(tmp, "t.parquet"), os.path.join(
+        tmp, "u.parquet")
+    pq.write_table(t, pt)
+    pq.write_table(u, pu)
+    sess.read.parquet(pt).createOrReplaceTempView("t")
+    sess.read.parquet(pu).createOrReplaceTempView("u")
+    return sess
+
+
+def _result_bytes(table: pa.Table) -> bytes:
+    return json.dumps(table.to_pydict(), sort_keys=True).encode()
+
+
+def _workload(session, url: str, timeout: float):
+    """One campaign iteration: all queries through a FRESH client (no
+    carried affinity) against the fleet; returns concatenated
+    deterministic bytes."""
+    rc = getattr(session, "serve_result_cache", None)
+    if rc is not None:
+        rc.clear()  # faults must reach the engine, not a cached blob
+    client = Client(url, timeout=timeout, retries=3)
+    out = []
+    for q in _QUERIES:
+        out.append(_result_bytes(client.sql(q)))
+    return b"\x00".join(out)
+
+
+def _campaign(session, fleet, args) -> bool:
+    conf = session.conf
+    clean = _workload(session, fleet.url, args.timeout)
+    # serve-tier points need the fleet; engine points fire inside the
+    # replicas — arm everything
+    schedules = chaos.generate_campaign(args.seed, args.schedules)
+    print(f"chaos campaign: seed={args.seed} "
+          f"schedules={args.schedules}")
+    report = chaos.run_campaign(
+        conf, lambda: _workload(session, fleet.url, args.timeout),
+        schedules, clean_bytes=clean, alarm_s=args.alarm,
+        queries=len(_QUERIES),
+        memory_manager=session.memory_manager,
+        artifact_path=args.artifact, log=print)
+    print(json.dumps(report.summary(), indent=2))
+    return report.ok
+
+
+def _replay(session, fleet, args) -> bool:
+    sch = chaos.replay_artifact(args.replay)
+    print(f"replaying schedule #{sch.index} "
+          f"(campaign seed {sch.campaign_seed}): {sch.describe()}")
+    clean = _workload(session, fleet.url, args.timeout)
+    r = chaos.run_schedule(
+        session.conf,
+        lambda: _workload(session, fleet.url, args.timeout),
+        sch, clean_bytes=clean, alarm_s=args.alarm,
+        queries=len(_QUERIES),
+        memory_manager=session.memory_manager)
+    print(json.dumps(r.to_dict(), indent=2))
+    return r.ok
+
+
+def _kill_revive(session, fleet, args) -> bool:
+    """Directed breaker scenario: kill -> open -> revive ->
+    half_open -> closed."""
+    conf = session.conf
+    fed = fleet.router.federation
+    conf.set("spark.tpu.serve.breaker.minRequests", 1)
+    conf.set("spark.tpu.serve.breaker.openSeconds", 0.3)
+    # throttle background health probes: otherwise the router's /health
+    # check notices the death first and sidelines the replica before a
+    # dispatch ever fails against it, so the breaker never trips. The
+    # scenario drives probes explicitly with probe(force=True).
+    conf.set("spark.tpu.serve.healthProbeSeconds", 3600.0)
+    try:
+        # the random sweep may have left stale unhealthy flags and a
+        # success-heavy breaker window from injected dispatch faults;
+        # re-probe and reset so this scenario starts from a live fleet
+        # with empty windows (one failure must reach failureRate)
+        fed.probe(force=True)
+        for r in fed.replicas:
+            r.breaker.reset()
+        client = Client(fleet.url, timeout=args.timeout, retries=3)
+        _result_bytes(client.sql(_QUERIES[0]))
+        victim_id = client.affinity
+        victim = next(s for s in fleet.replicas
+                      if s.replica_id == victim_id)
+        rep = next(r for r in fed.replicas if r.id == victim_id)
+        host, port = victim.host, victim.port
+        print(f"kill-revive: stopping replica {victim_id} "
+              f"({host}:{port})")
+        victim.stop()
+        # the affinity-routed request hits the dead replica, fails,
+        # re-dispatches, and the breaker opens (minRequests=1)
+        _result_bytes(client.sql(_QUERIES[1]))
+        state = rep.breaker.state
+        print(f"  after dispatch failure: breaker={state}")
+        if state != "open":
+            print("  FAIL: breaker did not open")
+            return False
+        revived = ConnectServer(session, host=host, port=port,
+                                replica_id=victim_id).start()
+        fleet.replicas.append(revived)
+        time.sleep(0.35)  # let openSeconds elapse
+        fed.probe(force=True)  # router sees the replica alive again
+        deadline_t = time.time() + 10.0
+        transitions = []
+        while rep.breaker.state != "closed" \
+                and time.time() < deadline_t:
+            client.affinity = victim_id  # aim the probe at it
+            _result_bytes(client.sql(_QUERIES[0]))
+            time.sleep(0.05)
+        transitions = [(a, b) for _, a, b in rep.breaker.state_changes]
+        print(f"  transitions: {transitions}")
+        ok = (("closed", "open") in transitions
+              and ("open", "half_open") in transitions
+              and ("half_open", "closed") in transitions
+              and rep.breaker.state == "closed")
+        print(f"  kill-revive: {'ok' if ok else 'FAIL'} "
+              f"(final={rep.breaker.state})")
+        return ok
+    finally:
+        conf.unset("spark.tpu.serve.breaker.minRequests")
+        conf.unset("spark.tpu.serve.breaker.openSeconds")
+        conf.unset("spark.tpu.serve.healthProbeSeconds")
+
+
+def _ab_attempts(session, fleet, args) -> bool:
+    """Same fault-heavy schedule, legacy vs budgeted retry
+    accounting."""
+    conf = session.conf
+    fed = fleet.router.federation
+    spec = f"prob:0.4:{args.seed}:transient"
+    counts = {}
+    for label, enabled in (("legacy", False), ("budgeted", True)):
+        # the previous leg's injected dispatch faults leave replicas
+        # flagged unhealthy; start each leg from a live fleet so both
+        # sides exercise the same dispatch path
+        fed.probe(force=True)
+        for r in fed.replicas:
+            r.breaker.reset()
+        conf.set("spark.tpu.recovery.retryBudget.enabled", enabled)
+        conf.set("spark.tpu.faultInjection.serve.dispatch", spec)
+        conf.set("spark.tpu.faultInjection.execute.device", spec)
+        faults.reset(conf)
+        before = metrics.retry_budget_stats()
+        try:
+            for _ in range(3):
+                try:
+                    _workload(session, fleet.url, args.timeout)
+                except Exception:
+                    pass  # typed failures are fine; counting attempts
+        finally:
+            conf.unset("spark.tpu.faultInjection.serve.dispatch")
+            conf.unset("spark.tpu.faultInjection.execute.device")
+            conf.unset("spark.tpu.recovery.retryBudget.enabled")
+            faults.reset(conf)
+        after = metrics.retry_budget_stats()
+        if enabled:
+            counts[label] = (after["draws"] - before["draws"]
+                             + after["floor_draws"]
+                             - before["floor_draws"])
+        else:
+            counts[label] = (after["legacy_attempts"]
+                             - before["legacy_attempts"])
+    budget = int(conf.get(recovery.RETRY_BUDGET_ATTEMPTS))
+    cap = 3 * len(_QUERIES) * budget
+    ok = counts["budgeted"] <= cap
+    print(f"A/B attempts: legacy={counts['legacy']} "
+          f"budgeted={counts['budgeted']} "
+          f"(cap {cap}: 3 iters x {len(_QUERIES)} queries x "
+          f"{budget} budget) -> {'ok' if ok else 'FAIL'}")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--schedules", type=int, default=25)
+    ap.add_argument("--alarm", type=float, default=90.0,
+                    help="per-schedule wall-clock bound (zero-hang)")
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="client per-request timeout (mints the "
+                         "propagated deadline)")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--artifact",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "chaos_fail.json"),
+                    help="replayable JSON written on first failure")
+    ap.add_argument("--replay", default=None,
+                    help="re-run one failing schedule from artifact")
+    ap.add_argument("--skip-scenarios", action="store_true",
+                    help="random sweep only (no kill-revive / A/B)")
+    args = ap.parse_args(argv)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        session = _make_session(tmp)
+        fleet = serve_fleet(session, replicas=args.replicas)
+        try:
+            if args.replay:
+                ok = _replay(session, fleet, args)
+            else:
+                ok = _campaign(session, fleet, args)
+                if not args.skip_scenarios:
+                    ok = _kill_revive(session, fleet, args) and ok
+                    ok = _ab_attempts(session, fleet, args) and ok
+        finally:
+            fleet.stop()
+            metrics.reset_brownout()
+    print(f"chaos campaign: {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """AST linter for spark_tpu codebase invariants.
 
-Six rules the engine relies on but Python cannot enforce:
+Seven rules the engine relies on but Python cannot enforce:
 
 1. **conf-keys** — every string key passed to ``conf.get(...)`` /
    ``conf.set(...)`` (and builder ``.config(...)``) that looks like a
@@ -43,6 +43,16 @@ Six rules the engine relies on but Python cannot enforce:
    and documents a recovery seam that does not exist — fault suites
    arming it would silently test nothing.
 
+7. **retry-budget** — every bounded retry loop (a ``for ... in
+   range(...)`` whose target or bound names attempts/retries) must
+   draw from the unified per-query retry budget: the enclosing
+   function has to reference ``recovery.retry_allowed`` /
+   ``RetryBudget`` / ``.draw(...)``. A loop that retries on its own
+   private counter multiplies with every other layer's counter —
+   exactly the attempt amplification the unified budget exists to
+   kill. Exemptions: ``retry_loop_allow = ["path.py:function"]`` in
+   ``[tool.lint-invariants]``.
+
 Run as a CLI (exit 0 clean / 1 findings) or import ``run_lint()``;
 tests/test_analysis.py runs it as a test so CI enforces it. Optional
 overrides live in ``[tool.lint-invariants]`` in pyproject.toml.
@@ -74,6 +84,10 @@ DEFAULT_CONFIG = {
                  "_LOG_BUF_PATH": "_IO_LOCK",
                  "_LOG_LAST_FLUSH": "_IO_LOCK"},
     "default_lock": "_LOCK",
+    # "path.py:function" entries exempt from rule 7 (retry-budget);
+    # recovery.py itself IMPLEMENTS the budget so its own draw loop
+    # is the mechanism, not a violator
+    "retry_loop_allow": [],
 }
 
 
@@ -104,7 +118,8 @@ def _load_config() -> dict:
     except OSError:
         return cfg
     user = data.get("tool", {}).get("lint-invariants", {})
-    for k in ("paths", "key_prefixes", "locked_modules"):
+    for k in ("paths", "key_prefixes", "locked_modules",
+              "retry_loop_allow"):
         if k in user:
             cfg[k] = list(user[k])
     return cfg
@@ -227,6 +242,70 @@ def _check_span_names(tree: ast.AST, rel: str,
                 f"span name {name!r} is not declared in "
                 "spark_tpu.trace.SPAN_NAMES — register it so the "
                 "waterfall/attribution rollups see it"))
+
+
+# ---- rule 7: bounded retry loops draw from the unified budget ---------------
+
+#: a loop is retry-shaped when its target or range bound names one of
+#: these (``for attempt in range(retries + 1)`` and friends)
+_RETRY_HINTS = ("attempt", "retry", "retries")
+
+#: the enclosing function satisfies the rule by referencing any of the
+#: unified-budget API surface
+_BUDGET_MARKERS = ("retry_allowed", "RetryBudget", "draw",
+                   "retry_budget", "bind_budget")
+
+
+def _check_retry_budget(tree: ast.AST, rel: str, cfg: dict,
+                        out: List[Finding]) -> None:
+    allow = set(cfg.get("retry_loop_allow", []))
+
+    def _hinted(name: str) -> bool:
+        low = name.lower()
+        return any(h in low for h in _RETRY_HINTS)
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        draws = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and node.id in _BUDGET_MARKERS:
+                draws = True
+                break
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _BUDGET_MARKERS:
+                draws = True
+                break
+        if draws or f"{rel}:{fn.name}" in allow:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.For):
+                continue
+            it = node.iter
+            if not (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range"):
+                continue
+            tgt = node.target
+            shaped = isinstance(tgt, ast.Name) and _hinted(tgt.id)
+            if not shaped:
+                for sub in ast.walk(it):
+                    nm = sub.id if isinstance(sub, ast.Name) else \
+                        sub.attr if isinstance(sub, ast.Attribute) \
+                        else None
+                    if nm is not None and _hinted(nm):
+                        shaped = True
+                        break
+            if shaped:
+                out.append(Finding(
+                    "retry-budget", rel, node.lineno,
+                    f"retry loop in {fn.name}() never draws from the "
+                    "unified RetryBudget (recovery.retry_allowed / "
+                    "budget.draw) — a private attempt counter "
+                    "multiplies with every other layer's; exempt via "
+                    "retry_loop_allow in [tool.lint-invariants] only "
+                    "if the loop genuinely is not a retry"))
 
 
 # ---- rule 3: fingerprint purity ---------------------------------------------
@@ -394,6 +473,7 @@ def run_lint(config: Optional[dict] = None) -> List[Finding]:
         _check_conf_keys(tree, rel, cfg, findings)
         _check_fault_points(tree, rel, findings, injected_points)
         _check_span_names(tree, rel, findings)
+        _check_retry_budget(tree, rel, cfg, findings)
         if rel in fingerprint:
             _check_fingerprint_purity(tree, rel, fingerprint[rel],
                                       findings)
