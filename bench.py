@@ -81,18 +81,40 @@ def _wall_remaining() -> float:
     return WALL_BUDGET_S - (time.time() - _WALL_T0)
 
 
-def _query_deadline(extra_s: float = 0.0) -> float:
+def _query_deadline(extra_s: float = 0.0, cap_s: float = None) -> float:
     """Per-query alarm, never longer than what the wall budget has
     left (so the last query degrades to a marked timeout instead of
     blowing the whole process budget). ``extra_s`` extends the cap for
     phases where a background fused compile runs concurrently with the
     measured query (compile/service hot-swap) — a query correctly
     served by the chunked tier while XLA compiles off-thread must not
-    be marked timed-out just because the compile is still running."""
+    be marked timed-out just because the compile is still running.
+    ``cap_s`` tightens the cap below QUERY_TIMEOUT_S for auxiliary
+    phases (see PHASE_BUDGET_S)."""
+    base = QUERY_TIMEOUT_S + extra_s
+    if cap_s is not None:
+        base = min(base, cap_s)
     rem = _wall_remaining()
     if rem == float("inf"):
-        return QUERY_TIMEOUT_S + extra_s
-    return max(1.0, min(QUERY_TIMEOUT_S + extra_s, rem))
+        return base
+    return max(1.0, min(base, rem))
+
+
+# Per-phase deadline caps. Before these, every auxiliary A/B phase ran
+# under the full QUERY_TIMEOUT_S (600s at SF<=10): two slow phases
+# could eat 1200s of a 3300s wall budget and starve everything after
+# them into "skipped" markers. The headline queries keep the full cap;
+# the A/B phases are all sub-minute in the common case and get a cap
+# sized ~3x their observed worst case instead.
+PHASE_BUDGET_S = {
+    "cached": 180.0, "adaptive": 240.0, "serving": 240.0,
+    "serve": 240.0, "mview": 180.0, "agg": 420.0, "join": 420.0,
+    "trace": 150.0,
+}
+
+
+def _phase_deadline(phase: str) -> float:
+    return _query_deadline(cap_s=PHASE_BUDGET_S.get(phase))
 
 
 class _QueryTimeout(Exception):
@@ -701,11 +723,25 @@ def main():
     results = {}
     import sys
 
+    # every phase (or query) skipped because the wall budget ran out,
+    # by name — the final JSON carries the explicit list so a reader
+    # never has to diff the expected phase set against what's present
+    wall_skipped = []
+
+    def _budget_skip(phase: str) -> dict:
+        wall_skipped.append(phase)
+        return {"error": "skipped: wall budget exhausted",
+                "phase": phase, "wall_budget_s": WALL_BUDGET_S}
+
+    def _phase_snapshot(**extra) -> None:
+        _snapshot({"partial": True, "sf": SF,
+                   "queries": {str(k): v for k, v in results.items()},
+                   "wall_budget_skipped": list(wall_skipped),
+                   "robustness": _robustness_counters(), **extra})
+
     for qnum in (1, 3, 5):
         if _wall_remaining() <= 5:
-            results[qnum] = {"error": "skipped: wall budget exhausted",
-                             "phase": f"headline:q{qnum}",
-                             "wall_budget_s": WALL_BUDGET_S}
+            results[qnum] = _budget_skip(f"headline:q{qnum}")
             continue
         print(f"[bench] q{qnum} starting", file=sys.stderr, flush=True)
         try:
@@ -720,16 +756,13 @@ def main():
             print(f"[bench] q{qnum} FAILED: {e}",
                   file=sys.stderr, flush=True)
             results[qnum] = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "robustness": _robustness_counters()})
+        _phase_snapshot()
 
 
     warmup = None
     if WARMUP_MODE:
         if _wall_remaining() <= 5:
-            warmup = {"error": "skipped: wall budget exhausted",
-                      "phase": "warmup"}
+            warmup = _budget_skip("warmup")
         else:
             print("[bench] warmup A/B: empty store vs populated store "
                   "vs background compile (fresh subprocesses)",
@@ -738,10 +771,7 @@ def main():
                 warmup = _run_warmup_ab(qnum=1)
             except Exception as e:
                 warmup = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "warmup": warmup,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(warmup=warmup)
 
     full = {}
     if FULL:
@@ -756,6 +786,7 @@ def main():
                 full[qnum] = f"skipped: sweep budget exhausted (all22:q{qnum})"
                 continue
             if _wall_remaining() <= 5:
+                wall_skipped.append(f"all22:q{qnum}")
                 full[qnum] = f"skipped: wall budget exhausted (all22:q{qnum})"
                 continue
             print(f"[bench] q{qnum} (sweep {elapsed:.0f}s)",
@@ -775,187 +806,159 @@ def main():
                 full[qnum] = f"error: timeout after {QUERY_TIMEOUT_S:.0f}s"
             except Exception as e:  # record, don't kill the headline
                 full[qnum] = f"error: {type(e).__name__}: {e}"
-            _snapshot({"partial": True, "sf": SF,
-                       "queries": {str(k): v for k, v in results.items()},
-                       "all22_ms": {str(k): v for k, v in full.items()},
-                       "robustness": _robustness_counters()})
+            _phase_snapshot(
+                all22_ms={str(k): v for k, v in full.items()})
 
     cached = None
     if CACHED_MODE:
         if _wall_remaining() <= 5:
-            cached = {"error": "skipped: wall budget exhausted",
-                      "phase": "cached"}
+            cached = _budget_skip("cached")
         else:
             print("[bench] cached mode: HBM-resident store re-runs",
                   file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("cached")):
                     cached = _run_cached(spark, (1, 3, 5))
             except _QueryTimeout:
                 cached = {"error": "timeout"}
             except Exception as e:
                 cached = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "cached": cached,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(cached=cached)
 
     adaptive = None
     if os.environ.get("BENCH_ADAPTIVE", "1") == "1":
         if _wall_remaining() <= 5:
-            adaptive = {"error": "skipped: wall budget exhausted",
-                        "phase": "adaptive"}
+            adaptive = _budget_skip("adaptive")
         else:
             print("[bench] adaptive A/B: spark.tpu.adaptive.enabled "
                   "off vs on", file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("adaptive")):
                     adaptive = _run_adaptive_compare(spark)
             except _QueryTimeout:
                 adaptive = {"error": "timeout"}
             except Exception as e:
                 adaptive = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "adaptive": adaptive,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(adaptive=adaptive)
 
     analysis_overhead = None
     if os.environ.get("BENCH_ANALYSIS", "1") == "1":
-        print("[bench] analyzer overhead: host-side static analysis "
-              "of the full 22-query suite", file=sys.stderr, flush=True)
-        try:
-            qnums = sorted(QUERIES) if FULL else (1, 3, 5)
-            analysis_overhead = _analysis_overhead(spark, qnums)
-        except Exception as e:
-            analysis_overhead = {"error": f"{type(e).__name__}: {e}"}
+        if _wall_remaining() <= 5:
+            analysis_overhead = _budget_skip("analysis")
+        else:
+            print("[bench] analyzer overhead: host-side static "
+                  "analysis of the full 22-query suite",
+                  file=sys.stderr, flush=True)
+            try:
+                qnums = sorted(QUERIES) if FULL else (1, 3, 5)
+                analysis_overhead = _analysis_overhead(spark, qnums)
+            except Exception as e:
+                analysis_overhead = {"error": f"{type(e).__name__}: {e}"}
+        _phase_snapshot(analysis=analysis_overhead)
 
     serving = None
     if args.concurrency > 0:
         if _wall_remaining() <= 5:
-            serving = {"error": "skipped: wall budget exhausted",
-                       "phase": "serving"}
+            serving = _budget_skip("serving")
         else:
             print(f"[bench] serving: {args.concurrency} concurrent "
                   "clients", file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("serving")):
                     serving = _run_serving(
                         spark, args.concurrency,
                         {q: QUERIES[q] for q in (1, 3, 5)},
                         rounds=args.serving_rounds)
             except Exception as e:
                 serving = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "serving": serving,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(serving=serving)
 
     serve_ab = None
     if args.replicas > 0 and args.concurrency > 0:
         if _wall_remaining() <= 5:
-            serve_ab = {"error": "skipped: wall budget exhausted",
-                        "phase": "serve"}
+            serve_ab = _budget_skip("serve")
         else:
             print(f"[bench] serve A/B: 1 replica cache off vs "
                   f"{args.replicas} replicas cache on "
                   f"({args.concurrency} clients over HTTP)",
                   file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("serve")):
                     serve_ab = _run_serve_ab(
                         spark, args.concurrency, args.replicas,
                         rounds=args.serving_rounds)
             except Exception as e:
                 serve_ab = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "serve": serve_ab,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(serve=serve_ab)
 
     mview = None
     if MVIEW_MODE:
         if _wall_remaining() <= 5:
-            mview = {"error": "skipped: wall budget exhausted",
-                     "phase": "mview"}
+            mview = _budget_skip("mview")
         else:
             print("[bench] mview A/B: appended micro-batches, "
                   "spark.tpu.mview.incremental off vs on",
                   file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("mview")):
                     mview = _run_mview_ab(spark)
             except _QueryTimeout:
                 mview = {"error": "timeout"}
             except Exception as e:
                 mview = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "mview": mview,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(mview=mview)
 
     agg_ab = None
     if AGG_MODE:
         if _wall_remaining() <= 5:
-            agg_ab = {"error": "skipped: wall budget exhausted",
-                      "phase": "agg"}
+            agg_ab = _budget_skip("agg")
         else:
-            print("[bench] agg A/B: low/high-NDV + skewed group-bys, "
-                  "spark.tpu.adaptive.agg.enabled off vs on",
+            print("[bench] agg A/B: low/high-NDV, huge-domain, skewed "
+                  "and hot-key group-bys, spark.tpu.adaptive.agg off "
+                  "vs on vs forced sort/presplit",
                   file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("agg")):
                     agg_ab = _run_agg_ab(spark)
             except _QueryTimeout:
                 agg_ab = {"error": "timeout"}
             except Exception as e:
                 agg_ab = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "agg": agg_ab,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(agg=agg_ab)
 
     join_ab = None
     if JOIN_MODE:
         if _wall_remaining() <= 5:
-            join_ab = {"error": "skipped: wall budget exhausted",
-                       "phase": "join"}
+            join_ab = _budget_skip("join")
         else:
             print("[bench] join A/B: grant-driven hybrid hash join at "
                   "full vs 1/8 memory budget vs the old OOM ladder",
                   file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("join")):
                     join_ab = _run_join_ab(spark)
             except _QueryTimeout:
                 join_ab = {"error": "timeout"}
             except Exception as e:
                 join_ab = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "join": join_ab,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(join=join_ab)
 
     trace_ab = None
     if TRACE_MODE:
         if _wall_remaining() <= 5:
-            trace_ab = {"error": "skipped: wall budget exhausted",
-                        "phase": "trace"}
+            trace_ab = _budget_skip("trace")
         else:
             print("[bench] trace A/B: q1/q3 span layer off vs on vs "
                   "sampled, + host/device/queue breakdown of one q3",
                   file=sys.stderr, flush=True)
             try:
-                with _deadline(_query_deadline()):
+                with _deadline(_phase_deadline("trace")):
                     trace_ab = _run_trace_ab(spark)
             except _QueryTimeout:
                 trace_ab = {"error": "timeout"}
             except Exception as e:
                 trace_ab = {"error": f"{type(e).__name__}: {e}"}
-        _snapshot({"partial": True, "sf": SF,
-                   "queries": {str(k): v for k, v in results.items()},
-                   "trace": trace_ab,
-                   "robustness": _robustness_counters()})
+        _phase_snapshot(trace=trace_ab)
 
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
@@ -985,6 +988,7 @@ def main():
         "robustness": _robustness_counters(),
         "wall_budget_s": WALL_BUDGET_S,
         "wall_used_s": round(time.time() - _WALL_T0, 1),
+        "wall_budget_skipped": wall_skipped,
         "queries": {str(k): v for k, v in results.items()},
         **({"warmup": warmup} if warmup is not None else {}),
         **({"cached": cached} if cached is not None else {}),
@@ -1105,17 +1109,28 @@ def _run_adaptive_compare(spark) -> dict:
 
 
 def _run_agg_ab(spark) -> dict:
-    """Adaptive-aggregation A/B: the three key distributions the
+    """Adaptive-aggregation A/B: the five key distributions the
     strategy switch discriminates — low NDV (hash-partial territory),
-    high NDV ~ rows (partial-bypass: pre-aggregation shrinks nothing,
-    the static plan pays a full sort-agg for zero reduction), and
-    skewed (the sketch sees through the hot key) — each timed with
+    high NDV ~ rows over a packable domain (partial-bypass:
+    pre-aggregation shrinks nothing), high NDV over a HUGE domain (the
+    sort rung: range exchange + segmented merge, key-ordered output
+    elides the downstream orderBy sort), skewed (the reactive skew fan
+    territory), and hot-key (one key dominates hard enough that the
+    Count-Min sketch elects proactive pre-splitting) — each timed with
     adaptive execution off (the static partial->final plan, exchanges
     fused at worst-case capacity) then fully on (AQE + the aggregation
-    strategy switch). Results must be byte-identical; the JSON records the
-    digest, per-strategy pick counts (metrics.agg_stats delta), and
-    the measured NDV/rows ratio per workload. Skipped on single-device
-    sessions (run with BENCH_MASTER=mesh[N] to engage)."""
+    strategy switch). Results must be byte-identical; the JSON records
+    the digest, per-strategy pick counts (metrics.agg_stats delta), and
+    the measured NDV/rows ratio per workload.
+
+    Fourth arm: per-workload FORCED strategies isolate the new rungs
+    against the best pre-existing alternative — ``sort`` forced on
+    high_ndv (vs the bypass auto used to pick), ``bypass`` forced on
+    huge_domain (vs the auto sort pick), and ``bypass``/``sort``
+    forced on hot_key (auto presplit vs the raw-row exchanges whose
+    hot destination the destination-reactive skew fan has to absorb).
+    Skipped on single-device sessions (run with BENCH_MASTER=mesh[N]
+    to engage)."""
     import numpy as np
     import pyarrow as pa
 
@@ -1130,13 +1145,39 @@ def _run_agg_ab(spark) -> dict:
     workloads = {
         "low_ndv": rng.integers(0, 64, n),
         "high_ndv": rng.permutation(n).astype(np.int64),
+        # near-distinct keys spread over ~1.2e11: beyond both the hash
+        # domain limit and sortDomainWidth, so auto lands on the sort
+        # rung (and the orderBy("k") below rides its sorted output)
+        "huge_domain": rng.permutation(n).astype(np.int64) * 1_000_003,
         "skewed": np.where(rng.random(n) < 0.9, 7,
                            rng.integers(0, 100000, n)),
+        # one key carries a third of the rows and the tail is
+        # near-distinct over a huge domain: the crossover elects a
+        # raw-row exchange (the sort rung), exactly where one hot key
+        # overloads a single destination — so the Count-Min estimate
+        # drives the pre-split rung instead
+        "hot_key": np.where(np.arange(n) % 3 == 0, 7,
+                            rng.permutation(n).astype(np.int64)
+                            * 1_000_003),
+    }
+    # fourth arm per workload: forced strategies that pin the baseline
+    # the new rung must beat — sort vs the bypass the crossover used
+    # to pick on high NDV, and presplit vs the raw-row strategies
+    # whose hot destination the reactive skew fan would handle
+    forced_arms = {
+        "high_ndv": ("sort",), "huge_domain": ("bypass",),
+        "skewed": ("partial",), "hot_key": ("bypass", "sort"),
     }
     out = {}
     conf = spark.conf
     try:
+        # hot-key threshold at 2x the fair per-device share (the
+        # conservative default 4x needs a >50% hot key at 8 devices)
+        conf.set("spark.tpu.adaptive.agg.presplitFactor", 2)
         for name, keys in workloads.items():
+            if _wall_remaining() <= 30:
+                out[name] = {"skipped": "wall budget exhausted"}
+                continue
             tbl = pa.table({
                 "k": pa.array(keys, pa.int64()),
                 "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
@@ -1146,9 +1187,13 @@ def _run_agg_ab(spark) -> dict:
                        F.min("v").alias("mn"), F.max("v").alias("mx"))
                   .orderBy("k"))
 
-            def timed(adaptive_on, agg_on):
+            def timed(adaptive_on, agg_on, force=None):
                 conf.set("spark.tpu.adaptive.enabled", adaptive_on)
                 conf.set("spark.tpu.adaptive.agg.enabled", agg_on)
+                if force:
+                    conf.set("spark.tpu.adaptive.agg.strategy", force)
+                else:
+                    conf.unset("spark.tpu.adaptive.agg.strategy")
                 df.toArrow()  # warm-up: compile off the clock
                 before = metrics.agg_stats()
                 t0 = time.perf_counter()
@@ -1159,15 +1204,25 @@ def _run_agg_ab(spark) -> dict:
                          if v - before.get(k, 0)}
                 return got, round(ms, 1), picks
 
-            # three arms: fully static plan / AQE with the static
-            # partial->final strategy / AQE + the strategy switch — so
-            # the switch's own contribution is visible on top of the
-            # capacity-compaction win AQE already provides
+            # four arms: fully static plan / AQE with the static
+            # partial->final strategy / AQE + the strategy switch /
+            # AQE with a pinned per-workload baseline strategy — so
+            # both the switch's own contribution (on top of AQE's
+            # capacity compaction) and the new rung's margin over the
+            # best pre-existing strategy are visible
             off_tbl, off_ms, _ = timed(False, False)
             _, aqe_ms, _ = timed(True, False)
             on_tbl, on_ms, picks = timed(True, True)
             ev = next((e for e in reversed(metrics.recent(256))
                        if e.get("kind") == "agg"), {})
+            forced = {}
+            for strat in forced_arms.get(name, ()):
+                f_tbl, f_ms, f_picks = timed(True, True, force=strat)
+                forced[strat] = {
+                    "ms": f_ms,
+                    "byte_identical": bool(f_tbl.equals(off_tbl)),
+                    "strategy_picks": f_picks,
+                }
             out[name] = {
                 "rows": n,
                 "off_ms": off_ms,
@@ -1180,8 +1235,12 @@ def _run_agg_ab(spark) -> dict:
                 "strategy_picks": picks,
                 "ndv_estimate": ev.get("ndv"),
                 "ndv_ratio": ev.get("ratio"),
+                "hot_keys": ev.get("hot_keys"),
+                **({"forced": forced} if forced else {}),
             }
     finally:
+        conf.unset("spark.tpu.adaptive.agg.presplitFactor")
+        conf.unset("spark.tpu.adaptive.agg.strategy")
         conf.unset("spark.tpu.adaptive.agg.enabled")
         conf.unset("spark.tpu.adaptive.enabled")
     return out

@@ -259,3 +259,38 @@ def strategy_verdict(aggregates, schema) -> Verdict:
             if not v.ok:
                 return v
     return OK
+
+
+def strategy_crossover(ndv_ratio: float, domain_width: int,
+                       bypass_ndv_ratio: float, hash_domain_limit: int,
+                       sort_domain_width: int) -> str:
+    """The sort/hash crossover of the adaptive-aggregation matrix: map
+    the two measured axes — estimated-NDV-to-row ratio and packed key
+    domain width (``-1`` = unbounded/unpackable, e.g. string keys or a
+    key range that overflows int64 packing) — to the cheapest legal
+    non-static strategy. One pure function so the runtime switch, its
+    EXPLAIN diagnostic, and the boundary-cell tests all share the same
+    rule (code PLAN-AGG-STRATEGY surfaces this matrix when a strategy
+    is pinned instead):
+
+    - low NDV ratio, small domain  -> ``"hash"``  (dense per-device
+      table, no sort; beats sorting when partials shrink the data)
+    - low NDV ratio, wide domain   -> ``"partial"`` (partial->final:
+      partials still shrink rows, but no dense table fits)
+    - high NDV ratio, small-enough domain -> ``"bypass"`` (partials
+      would not shrink; one exchange of raw rows, single final agg)
+    - high NDV ratio, huge/unbounded domain -> ``"sort"`` (the sort
+      rung: range exchange + segmented merge; near-distinct keys over
+      a huge domain make hashing's random access and bypass's single
+      unsorted final both worse than one routing sort that also yields
+      key-ordered output for free)
+    """
+    high_ndv = ndv_ratio >= bypass_ndv_ratio
+    small_domain = 0 <= domain_width <= hash_domain_limit
+    if high_ndv:
+        if domain_width < 0 or domain_width > sort_domain_width:
+            return "sort"
+        return "bypass"
+    if small_domain:
+        return "hash"
+    return "partial"

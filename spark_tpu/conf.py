@@ -281,9 +281,10 @@ ADAPTIVE_AGG_ENABLED = register(
 ADAPTIVE_AGG_STRATEGY = register(
     "spark.tpu.adaptive.agg.strategy", "auto",
     "Aggregation strategy override: 'auto' decides from the runtime "
-    "sketch; 'partial', 'bypass', or 'hash' force one strategy (an "
-    "illegal forced choice falls back to 'partial' so results stay "
-    "byte-identical). Test/debug knob.", str)
+    "sketch; 'partial', 'bypass', 'hash', 'sort', or 'presplit' force "
+    "one strategy (an illegal or unexecutable forced choice falls "
+    "back to 'partial' so results stay byte-identical). Test/debug "
+    "knob.", str)
 
 ADAPTIVE_AGG_BYPASS_NDV_RATIO = register(
     "spark.tpu.adaptive.agg.bypassNdvRatio", 0.5,
@@ -308,6 +309,55 @@ ADAPTIVE_AGG_SKETCH_REGISTERS = register(
     "give ~5% relative error — plenty to separate 'NDV ~ rows' from "
     "'NDV << rows' — and ride the existing stats fetch as one extra "
     "O(registers) int vector.", int)
+
+ADAPTIVE_AGG_SORT_DOMAIN_WIDTH = register(
+    "spark.tpu.adaptive.agg.sortDomainWidth", 1 << 20,
+    "Sort/hash crossover: a high-NDV grouping (NDV ratio past "
+    "bypassNdvRatio) whose measured packed key-code domain exceeds "
+    "this width takes the SORT rung — raw rows range-partition by the "
+    "leading group key (the stable routing sort inside the tiled "
+    "all_to_all doubles as the coarse key sort) and the final "
+    "segmented-scan merge emits key-ordered output, which a matching "
+    "downstream global sort then skips entirely. Below it the "
+    "hash-exchange bypass keeps cheaper routing ('Hash-Based vs. "
+    "Sort-Based Group-By-Aggregate', arXiv 2411.13245: sort-merge "
+    "grouping wins at high NDV x large key domains, and ordered "
+    "output is free).", int)
+
+ADAPTIVE_AGG_PRESPLIT_FACTOR = register(
+    "spark.tpu.adaptive.agg.presplitFactor", 4,
+    "Hot-KEY pre-split threshold: a group key whose Count-Min "
+    "estimated row count exceeds this multiple of the fair per-device "
+    "share (rows / D) is salted across ALL devices BEFORE the "
+    "exchange — the partial accumulators re-merge exactly through the "
+    "ordinary partial->final path — instead of letting one "
+    "destination absorb the whole key and fanning it afterwards "
+    "(contrast: spark.tpu.adaptive.skewedPartitionFactor reacts to hot "
+    "DESTINATIONS after routing).", int)
+
+ADAPTIVE_AGG_PRESPLIT_MIN_ROWS = register(
+    "spark.tpu.adaptive.agg.presplitMinRows", 4096,
+    "Absolute floor for the hot-key pre-split: the hottest key's "
+    "Count-Min estimate must reach this many rows (the factor alone "
+    "misfires on tiny inputs — same pairing the skew fan and the "
+    "reference's SKEW_JOIN_SKEWED_PARTITION_THRESHOLD use).", int)
+
+ADAPTIVE_AGG_CM_DEPTH = register(
+    "spark.tpu.adaptive.agg.cmDepth", 4,
+    "Count-Min sketch depth (independent hash rows) for the heavy-"
+    "hitter estimate in the exchange stats stage. The estimate is the "
+    "min over rows, so it never under-counts; depth d bounds the "
+    "over-count tail at ~(1/2)^d confidence per the standard CM "
+    "analysis (reference shape: common/sketch CountMinSketch.java).",
+    int)
+
+ADAPTIVE_AGG_CM_WIDTH = register(
+    "spark.tpu.adaptive.agg.cmWidth", 1024,
+    "Count-Min sketch width (counters per row, power of two). "
+    "Over-count per estimate is bounded by rows/width in expectation; "
+    "1024 counters resolve a >=4096-row hot key in a 120k-row "
+    "exchange with slack. Rides the existing stats fetch as depth "
+    "extra O(width) int vectors, psum-merged across the mesh.", int)
 
 SEARCHSORTED_SORT_THRESHOLD = register(
     "spark.tpu.kernels.searchsortedSortThreshold", 50,

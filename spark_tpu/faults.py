@@ -52,6 +52,14 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          strategy — the sketch is advisory, its result
                          is discarded on failure, so even a 'corrupt'
                          sketch cannot change bytes
+- ``agg.presplit``       the hot-key pre-split arm of the adaptive
+                         aggregation switch (parallel/executor.py),
+                         fired after the Count-Min heavy-hitter scan
+                         elects pre-splitting but before the salted
+                         exchange is built: ANY kind degrades to the
+                         static partial->final strategy — like
+                         ``agg.strategy``, the candidate list is pure
+                         advice and is discarded whole on failure
 - ``join.spill``         the hybrid hash join's host-spill seams
                          (physical/chunked.py _HybridHashJoinAgg):
                          spill-file WRITE during the partition pass,
@@ -125,6 +133,7 @@ POINTS = (
     "serve.dispatch",
     "mview.refresh",
     "agg.strategy",
+    "agg.presplit",
     "join.spill",
 )
 

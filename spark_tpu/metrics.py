@@ -388,8 +388,9 @@ def reset_serve() -> None:
 #: sketch failures absorbed by falling back to partial->final, and how
 #: many decisions ran with a forced conf override. Shown in
 #: tracing.aggregation_profile and /api/v1/agg.
-_AGG = {"partial": 0, "bypass": 0, "hash": 0, "pinned": 0,
-        "sketch_failures": 0, "forced": 0}
+_AGG = {"partial": 0, "bypass": 0, "hash": 0, "sort": 0, "presplit": 0,
+        "pinned": 0, "sketch_failures": 0, "presplit_failures": 0,
+        "forced": 0, "sort_elided": 0}
 
 
 def note_agg(kind: str, n: int = 1) -> None:

@@ -59,25 +59,10 @@ FORCE_ADAPTIVE: contextvars.ContextVar = contextvars.ContextVar(
     "spark_tpu_force_adaptive", default=False)
 
 
-def hll_estimate(registers: np.ndarray) -> float:
-    """HyperLogLog distinct estimate from register maxima: harmonic
-    mean alpha_m * m^2 / sum(2^-M_j), with the standard linear-counting
-    correction (m * ln(m / V), V = zero registers) in the small range
-    where raw HLL biases high (Flajolet et al. 2007, the same
-    corrections the reference's HyperLogLogPlusPlusHelper applies).
-    Module-level so both the device sketch (the adaptive-aggregation
-    stats stage below) and the hybrid hash join's host-side partition
-    oracle (physical/chunked.py) share one estimator."""
-    m = int(registers.size)
-    if m == 0:
-        return 0.0
-    alpha = 0.7213 / (1.0 + 1.079 / m)
-    est = alpha * m * m / float(
-        np.sum(np.power(2.0, -registers.astype(np.float64))))
-    zeros = int((registers == 0).sum())
-    if est <= 2.5 * m and zeros:
-        est = m * math.log(m / zeros)
-    return float(est)
+#: the ONE HLL estimator (spark_tpu/sketch.py) — re-exported here so
+#: existing callers (tests, physical/chunked.py historically) keep
+#: resolving executor.hll_estimate
+from spark_tpu.sketch import hll_estimate  # noqa: E402,F401
 
 #: exchange kinds the AQE pass cuts into separate stages (broadcast /
 #: single-partition exchanges use the all_gather data plane — there is
@@ -115,6 +100,45 @@ def _exactly_remergeable(consumer: "D.DistSortAggExec",
 
     return bool(legality.remerge_verdict_cols(consumer.aggregates,
                                               schema))
+
+
+def _project_sorted_by(sorted_by, exprs):
+    """Translate a ShardedBatch ``sorted_by`` guarantee through a
+    row-wise projection: every ordered column must survive (as a bare
+    Col or Alias(Col)) under its projected name, else the guarantee is
+    dropped — a partial translation would let a later sort elide on a
+    prefix whose tie order the static plan resolves differently."""
+    if not sorted_by:
+        return None
+    out = []
+    for name, asc, nf in sorted_by:
+        for e in exprs:
+            c = E.strip_alias(e)
+            if isinstance(c, E.Col) and c.col_name == name:
+                out.append((e.name, asc, nf))
+                break
+        else:
+            return None
+    return tuple(out)
+
+
+def _sorted_by_satisfies(sorted_by, orders) -> bool:
+    """True when a batch's ``sorted_by`` guarantee makes a global sort
+    by ``orders`` a no-op. Requires an EXACT pairwise match over the
+    full tuple (bare Col orders, same ascending/nulls placement): equal
+    length means the order is total over the guaranteed columns — on
+    unique-key aggregate output there are no ties left for the skipped
+    sort to break differently from the static plan."""
+    if not sorted_by or len(orders) != len(sorted_by):
+        return False
+    for o, (name, asc, nf) in zip(orders, sorted_by):
+        c = E.strip_alias(o.child)
+        if not (isinstance(c, E.Col) and c.col_name == name):
+            return False
+        if bool(o.ascending) != bool(asc) \
+                or bool(o.nulls_first_resolved) != bool(nf):
+            return False
+    return True
 
 
 @dataclass(eq=False)
@@ -522,6 +546,18 @@ class MeshExecutor:
                     final=plan, ex=plan.child, partial=plan.child.child)
             sb = self._run_adaptive_exchange(plan.child, consumer=plan)
             return dataclasses.replace(plan, child=D.ShardScanExec(sb))
+        if (isinstance(plan, P.SortExec)
+                and isinstance(plan.child, D.RangeExchangeExec)):
+            # global sort = local sort over a range exchange. When the
+            # exchange elides (the producer already carries a TOTAL
+            # key order matching these exact orders — no ties for the
+            # skipped shuffle to break differently), the local sort is
+            # the identity on its prefix-packed input: skip the whole
+            # Sort stage, not just the exchange
+            sb = self._run_adaptive_exchange(plan.child)
+            if _sorted_by_satisfies(sb.sorted_by, plan.orders):
+                return D.ShardScanExec(sb)
+            return dataclasses.replace(plan, child=D.ShardScanExec(sb))
         if isinstance(plan, _ADAPTIVE_EXCHANGES):
             return D.ShardScanExec(self._run_adaptive_exchange(plan))
         fields = {}
@@ -539,13 +575,39 @@ class MeshExecutor:
     def _run_adaptive_exchange(self, ex: P.PhysicalPlan,
                                consumer=None) -> ShardedBatch:
         """Run the producer side of one exchange as its own stage, then
-        the exchange itself under measured capacity bounds."""
+        the exchange itself under measured capacity bounds — unless the
+        producer's batch already carries a ``sorted_by`` guarantee that
+        satisfies a range exchange's orders (the sort-based aggregation
+        rung's key-ordered output): then the whole global sort shuffle
+        collapses to a no-op and the batch passes through."""
+        from spark_tpu import metrics
+
         child = self._materialize_exchanges(ex.child)
-        if isinstance(child, D.ShardScanExec):
-            child_sb = child.sharded
-        else:
-            child_sb = self.run(child)
+        child_sb = self._producer_batch(child)
+        if (isinstance(ex, D.RangeExchangeExec)
+                and _sorted_by_satisfies(child_sb.sorted_by, ex.orders)):
+            metrics.record("aqe", decision="sort_elide", op="range",
+                           orders=tuple(s[0] for s in child_sb.sorted_by))
+            metrics.note_agg("sort_elided")
+            return child_sb
         return self._exchange_with_stats(ex, child_sb, consumer=consumer)
+
+    def _producer_batch(self, child: P.PhysicalPlan) -> ShardedBatch:
+        """Materialized producer plan -> ShardedBatch, carrying a
+        ``sorted_by`` order guarantee through a row-wise projection of
+        an already-ordered scan (projections are 1:1 and keep row
+        order, so the guarantee survives under the projected names)."""
+        if isinstance(child, D.ShardScanExec):
+            return child.sharded
+        sorted_by = None
+        if (isinstance(child, P.ProjectExec)
+                and isinstance(child.child, D.ShardScanExec)):
+            sorted_by = _project_sorted_by(
+                child.child.sharded.sorted_by, child.exprs)
+        sb = self.run(child)
+        if sorted_by:
+            sb.sorted_by = sorted_by
+        return sb
 
     def _exchange_with_stats(self, ex: P.PhysicalPlan,
                              child_sb: ShardedBatch, consumer=None,
@@ -631,15 +693,33 @@ class MeshExecutor:
         One extended stats stage over the RAW rows (the exchange the
         bypass strategy would run) measures, in a single fetch:
         routing counts (``__incoming``/``__maxslice``), an HLL distinct
-        sketch over the group keys (``__ndvreg``), and per-key global
-        min/max/null counts (``__kmin``/``__kmax``/``__knull``). The
+        sketch over the group keys (``__ndvreg``), per-key global
+        min/max/null counts (``__kmin``/``__kmax``/``__knull``), and a
+        Count-Min heavy-hitter probe (``__hothash``/``__hotest``). The
         host then picks, per aggregate:
 
-        - ``bypass``  estimated NDV ~ live rows: pre-aggregation cannot
-          shrink anything, so skip it — exchange raw rows by key
-          straight to the final-equivalent aggregate (the partial node
-          re-rooted on the exchanged rows; schemas are identical by the
-          AggSpec alias contract).
+        - ``presplit`` the Count-Min probe found a KEY whose frequency
+          alone overloads a device AND the crossover elected a raw-row
+          exchange (bypass/sort — the strategies a hot key actually
+          imbalances; partial/hash collapse it to one row per device
+          first): salt the hot keys' raw rows round-robin over ALL
+          devices BEFORE the exchange (salted sub-keys), partial-merge
+          the salted shards, and exchange the now-balanced partials
+          into the final merge — the source-side dual of the
+          destination-reactive skew fan, acting before the imbalance
+          instead of after it.
+        - ``bypass``  estimated NDV ~ live rows, bounded key domain:
+          pre-aggregation cannot shrink anything, so skip it —
+          exchange raw rows by key straight to the final-equivalent
+          aggregate (the partial node re-rooted on the exchanged rows;
+          schemas are identical by the AggSpec alias contract).
+        - ``sort``    estimated NDV ~ live rows AND the packed key
+          domain is huge or unbounded (legality.strategy_crossover):
+          range-partition the raw rows on the group keys and run one
+          sorted segmented merge per device (DistRangeAggExec) — a
+          distributed sort-aggregate whose output is key-ordered
+          across the whole mesh, so a matching downstream global Sort
+          elides entirely (_run_adaptive_exchange).
         - ``hash``    small measured key domain: swap the sort partial
           for DistHashPartialAggExec over measured packed codes (dense
           segment reductions through the measured selection table).
@@ -650,12 +730,16 @@ class MeshExecutor:
         partials, float Min/Max) pin to ``partial``; every legal
         strategy is byte-identical to it (exact integer merges are
         associative+commutative, routing depends only on key values,
-        and the final merge re-sorts per device), pinned by the
-        on/off x strategy sweep in tests/test_agg_adaptive.py.
+        and the final merge re-sorts per device — and pre-splitting in
+        particular only re-partitions rows the partials are invariant
+        to), pinned by the on/off x strategy sweep in
+        tests/test_agg_adaptive.py.
 
-        The sketch is advisory: ANY injected fault at ``agg.strategy``
-        (even 'corrupt' — the estimate is discarded, never merged into
-        results) degrades to the static plan."""
+        The sketches are advisory: ANY injected fault at
+        ``agg.strategy`` (even 'corrupt' — the estimate is discarded,
+        never merged into results) degrades to the static plan, and
+        ``agg.presplit`` does the same for an elected pre-split, whole
+        candidate list discarded."""
         from spark_tpu import faults, metrics
         from spark_tpu.analysis import legality
 
@@ -688,75 +772,143 @@ class MeshExecutor:
         except Exception:
             nk = 0
 
-        stats_sb = self._run_stage(D.ExchangeStatsExec(
-            raw_ex, sketch_registers=r, key_stats=nk))
-        cols = stats_sb.data.columns
-        incoming = np.asarray(cols[0].data)[:d].astype(np.int64)
-        maxslice = np.asarray(cols[1].data)[:d].astype(np.int64)
-        rows = int(incoming.sum())
+        cmd = max(1, min(len(D._CM_SEEDS),
+                         int(self.conf.get(CF.ADAPTIVE_AGG_CM_DEPTH))))
+        cmw = max(64, min(1 << 16,
+                          int(self.conf.get(CF.ADAPTIVE_AGG_CM_WIDTH))))
+        if cmw & (cmw - 1):
+            cmw = 1 << (cmw.bit_length() - 1)
+        use_cm = d > 1  # pre-splitting needs somewhere to spread to
 
-        verdict = legality.strategy_verdict(partial.aggregates,
-                                            partial.child.schema)
-        forced = str(self.conf.get(CF.ADAPTIVE_AGG_STRATEGY)).lower()
+        with _trace.span("agg.decide", node=final.node_string()):
+            stats_sb = self._run_stage(D.ExchangeStatsExec(
+                raw_ex, sketch_registers=r, key_stats=nk,
+                cm_depth=cmd if use_cm else 0,
+                cm_width=cmw if use_cm else 0))
+            cols = stats_sb.data.columns
+            incoming = np.asarray(cols[0].data)[:d].astype(np.int64)
+            maxslice = np.asarray(cols[1].data)[:d].astype(np.int64)
+            rows = int(incoming.sum())
 
-        ndv = 0
-        ratio = 0.0
-        mins: Tuple[int, ...] = ()
-        ranges: Tuple[int, ...] = ()
-        domain = 0
-        try:
-            # fault seam: everything the sketch feeds the decision sits
-            # inside this block, so an injected failure of ANY kind
-            # degrades to the static plan with the estimate discarded
-            faults.inject("agg.strategy", self.conf)
-            registers = np.asarray(cols[2].data)[:r].astype(np.int64)
-            ndv = min(rows, int(round(self._hll_estimate(registers))))
-            ratio = (ndv / rows) if rows else 0.0
-            if nk and rows:
-                kmin = np.asarray(cols[3].data)[:nk].astype(np.int64)
-                kmax = np.asarray(cols[4].data)[:nk].astype(np.int64)
-                if bool(np.all(kmin <= kmax)):
-                    mins = tuple(int(v) for v in kmin)
-                    ranges = tuple(int(mx - mn + 1)
-                                   for mn, mx in zip(kmin, kmax))
-                    domain = 1
-                    for rg in ranges:
-                        domain *= rg + 1  # + null slot per key
-                        if domain > (1 << 62):
-                            domain = 1 << 62
-                            break
-            sketch_ok = True
-        except faults.InjectedFault as e:
-            metrics.note_agg("sketch_failures")
-            metrics.record("fault_recovered", point="agg.strategy",
-                           fault=e.kind, action="static_partial_final")
-            sketch_ok = False
+            verdict = legality.strategy_verdict(partial.aggregates,
+                                                partial.child.schema)
+            forced = str(self.conf.get(CF.ADAPTIVE_AGG_STRATEGY)).lower()
 
-        hash_ok = bool(ranges) and 0 < domain <= int(
-            self.conf.get(CF.ADAPTIVE_AGG_HASH_DOMAIN_LIMIT))
-        if not sketch_ok:
-            strategy, mode = "partial", "fallback"
-        elif not verdict.ok:
-            strategy, mode = "partial", "pinned"
-            metrics.note_agg("pinned")
-        elif forced in ("partial", "bypass", "hash"):
-            # an unexecutable forced choice falls back to partial (the
-            # conf doc promises forcing never breaks a query)
-            strategy = forced if (forced != "hash" or hash_ok) \
-                else "partial"
-            mode = "forced"
-            metrics.note_agg("forced")
-        elif rows and ratio >= float(
-                self.conf.get(CF.ADAPTIVE_AGG_BYPASS_NDV_RATIO)):
-            strategy, mode = "bypass", "auto"
-        elif hash_ok:
-            strategy, mode = "hash", "auto"
-        else:
-            strategy, mode = "partial", "auto"
+            ndv = 0
+            ratio = 0.0
+            mins: Tuple[int, ...] = ()
+            ranges: Tuple[int, ...] = ()
+            domain = 0
+            hot_hashes: Tuple[int, ...] = ()
+            try:
+                # fault seam: everything the sketches feed the decision
+                # sits inside this block, so an injected failure of ANY
+                # kind degrades to the static plan, estimates discarded
+                faults.inject("agg.strategy", self.conf)
+                registers = np.asarray(cols[2].data)[:r].astype(np.int64)
+                ndv = min(rows, int(round(self._hll_estimate(registers))))
+                ratio = (ndv / rows) if rows else 0.0
+                ci = 3
+                if nk and rows:
+                    kmin = np.asarray(cols[ci].data)[:nk].astype(np.int64)
+                    kmax = np.asarray(
+                        cols[ci + 1].data)[:nk].astype(np.int64)
+                    if bool(np.all(kmin <= kmax)):
+                        mins = tuple(int(v) for v in kmin)
+                        ranges = tuple(int(mx - mn + 1)
+                                       for mn, mx in zip(kmin, kmax))
+                        domain = 1
+                        for rg in ranges:
+                            domain *= rg + 1  # + null slot per key
+                            if domain > (1 << 62):
+                                domain = 1 << 62
+                                break
+                ci += 3 if nk else 0
+                if use_cm and rows:
+                    hh = np.asarray(
+                        cols[ci].data)[:d].astype(np.int64)
+                    he = np.asarray(
+                        cols[ci + 1].data)[:d].astype(np.int64)
+                    # hot = one KEY alone would overload a device: its
+                    # CM estimate tops the fair per-device share by the
+                    # presplit factor (CM overestimates, never misses,
+                    # so a collision can only salt a cold key — which
+                    # the partials' partition-invariance makes free)
+                    cut = max(
+                        int(self.conf.get(
+                            CF.ADAPTIVE_AGG_PRESPLIT_MIN_ROWS)),
+                        int(self.conf.get(
+                            CF.ADAPTIVE_AGG_PRESPLIT_FACTOR))
+                        * max(1, rows // d))
+                    hot_hashes = tuple(sorted(
+                        {int(h) for h, e in zip(
+                            hh.astype(np.uint64), he)
+                         if int(e) >= cut}))
+                sketch_ok = True
+            except faults.InjectedFault as e:
+                metrics.note_agg("sketch_failures")
+                metrics.record("fault_recovered", point="agg.strategy",
+                               fault=e.kind,
+                               action="static_partial_final")
+                sketch_ok = False
+
+            hash_ok = bool(ranges) and 0 < domain <= int(
+                self.conf.get(CF.ADAPTIVE_AGG_HASH_DOMAIN_LIMIT))
+            presplit_ok = bool(hot_hashes) and d > 1
+            if not sketch_ok:
+                strategy, mode = "partial", "fallback"
+            elif not verdict.ok:
+                strategy, mode = "partial", "pinned"
+                metrics.note_agg("pinned")
+            elif forced in ("partial", "bypass", "hash", "sort",
+                            "presplit"):
+                # an unexecutable forced choice falls back to partial
+                # (the conf doc promises forcing never breaks a query)
+                strategy = forced
+                if (forced == "hash" and not hash_ok) \
+                        or (forced == "presplit" and not presplit_ok):
+                    strategy = "partial"
+                mode = "forced"
+                metrics.note_agg("forced")
+            elif rows:
+                strategy = legality.strategy_crossover(
+                    ratio, domain if ranges else -1,
+                    float(self.conf.get(
+                        CF.ADAPTIVE_AGG_BYPASS_NDV_RATIO)),
+                    int(self.conf.get(
+                        CF.ADAPTIVE_AGG_HASH_DOMAIN_LIMIT)),
+                    int(self.conf.get(
+                        CF.ADAPTIVE_AGG_SORT_DOMAIN_WIDTH)))
+                mode = "auto"
+                # pre-splitting only beats the alternatives when the
+                # elected strategy exchanges RAW rows (bypass routes a
+                # hot key's every row to one destination; the sort
+                # rung's range partition owns it on one device). The
+                # partial/hash strategies already collapse a hot key to
+                # ONE row per device before their exchange — salting
+                # would add a whole extra exchange for nothing.
+                if strategy in ("bypass", "sort") and presplit_ok:
+                    strategy = "presplit"
+            else:
+                strategy, mode = "partial", "auto"
+
+            if strategy == "presplit":
+                # second seam: the candidate list is pure advice — an
+                # injected fault of ANY kind discards it whole and
+                # degrades to the static partial->final plan
+                try:
+                    faults.inject("agg.presplit", self.conf)
+                except faults.InjectedFault as e:
+                    metrics.note_agg("presplit_failures")
+                    metrics.record("fault_recovered",
+                                   point="agg.presplit", fault=e.kind,
+                                   action="static_partial_final")
+                    strategy, mode = "partial", "presplit_fallback"
 
         metrics.record("agg", strategy=strategy, mode=mode, ndv=int(ndv),
                        rows=rows, ratio=round(ratio, 4),
                        domain=int(domain), devices=d,
+                       hot_keys=len(hot_hashes),
                        node=final.node_string())
         metrics.note_agg(strategy)
         metrics.set_gauge("agg.last_ndv", int(ndv))
@@ -785,6 +937,46 @@ class MeshExecutor:
             return dataclasses.replace(
                 partial, child=D.ShardScanExec(sb), phase=None)
 
+        if strategy == "sort":
+            # the sort rung: range-partition the RAW rows on the group
+            # keys (equal keys co-locate and devices own disjoint key
+            # ranges), then one per-device sort-and-segment merge
+            # completes a distributed sort-aggregate — output is
+            # key-ordered across the mesh, marked on the batch so a
+            # matching downstream global Sort elides entirely
+            with _trace.span("agg.sort", rows=rows, ndv=int(ndv)):
+                orders = tuple(E.SortOrder(E.strip_alias(g))
+                               for g in partial.groupings)
+                range_ex = D.RangeExchangeExec(
+                    orders, D.ShardScanExec(child_sb))
+                ex_sb = self._exchange_with_stats(range_ex, child_sb)
+                out_sb = self._run_stage(D.DistRangeAggExec(
+                    tuple(partial.groupings),
+                    tuple(partial.aggregates),
+                    D.ShardScanExec(ex_sb)))
+                out_sb.sorted_by = self._agg_sorted_by(partial)
+            return D.ShardScanExec(out_sb)
+
+        if strategy == "presplit":
+            # hot KEYS spread over every device BEFORE the exchange
+            # (salted sub-keys), partial-merge the salted shards, then
+            # the now-balanced partials take the ordinary exchange into
+            # the final merge — the source-side dual of the skew fan,
+            # acting on hot KEYS before the imbalance instead of hot
+            # DESTINATIONS after it
+            with _trace.span("agg.presplit", hot=len(hot_hashes),
+                             rows=rows):
+                salted = dataclasses.replace(
+                    raw_ex, presplit_hashes=hot_hashes)
+                salted_sb = self._exchange_with_stats(
+                    salted, child_sb, consumer=None, allow_skew=False)
+                pre_sb = self._run_stage(dataclasses.replace(
+                    partial, child=D.ShardScanExec(salted_sb)))
+                sb = self._exchange_with_stats(
+                    ex, pre_sb, consumer=None, allow_skew=False)
+            return dataclasses.replace(final,
+                                       child=D.ShardScanExec(sb))
+
         if strategy == "hash":
             pre: P.PhysicalPlan = D.DistHashPartialAggExec(
                 tuple(partial.groupings), tuple(partial.aggregates),
@@ -796,6 +988,36 @@ class MeshExecutor:
         sb = self._run_adaptive_exchange(
             dataclasses.replace(ex, child=pre), consumer=final)
         return dataclasses.replace(final, child=D.ShardScanExec(sb))
+
+    def _agg_sorted_by(self, partial: "D.DistSortAggExec"):
+        """The ``sorted_by`` guarantee of the sort rung's output under
+        the partial's ``__k{i}`` key aliases, or None when the key
+        types cannot carry one: dictionary strings range-partition by
+        RANK but sort locally by CODE, so the rung's output is grouped
+        correctly yet not globally string-ordered; floats never reach
+        here (strategy pinned) but are excluded anyway. Integer-coded
+        orderable keys (ints, bools, dates, decimals) qualify — their
+        code order IS their value order on both sides."""
+        from spark_tpu.analysis import legality
+
+        out = []
+        for i, g in enumerate(partial.groupings):
+            try:
+                dt_engine = E.strip_alias(g).data_type(
+                    partial.child.schema)
+                dt = legality._np_dtype(dt_engine)
+            except Exception:
+                return None
+            if isinstance(dt_engine, T.StringType) \
+                    or np.issubdtype(dt, np.floating):
+                return None
+            alias = partial.aggregates[i]
+            if not (isinstance(alias, E.Alias)
+                    and E.expr_key(alias.child) == E.expr_key(
+                        E.strip_alias(g))):
+                return None
+            out.append((alias.name, True, True))
+        return tuple(out)
 
     def _materialize_boundaries(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
         if isinstance(plan, D.DistJoinBoundary):

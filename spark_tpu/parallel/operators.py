@@ -90,6 +90,15 @@ class DistRangeExec(P.PhysicalPlan):
 
 # ---- exchanges --------------------------------------------------------------
 
+#: fixed odd 64-bit seeds for the Count-Min hash rows (pairwise-
+#: independent enough through the avalanche rehash; depth <= 8). Fixed
+#: so the probe participates in the jit plan cache like every other
+#: trace constant.
+_CM_SEEDS = (0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F,
+             0x165667B19E3779F9, 0x27D4EB2F165667C5,
+             0x85EBCA77C2B2AE63, 0x2545F4914F6CDD1D,
+             0xD6E8FEB86659FD93, 0xA24BAED4963EE407)
+
 
 @dataclass(eq=False)
 class HashPartitionExchangeExec(P.PhysicalPlan):
@@ -104,7 +113,14 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
     ``slice_capacity``/``out_capacity`` bound the send slice and the
     received capacity (see exchange.exchange); ``fan_destinations``
     reroutes rows bound for skewed destinations back to their source
-    device (exchange.fan_local) ahead of a partial-aggregate pre-merge.
+    device (exchange.fan_local) ahead of a partial-aggregate pre-merge;
+    ``presplit_hashes`` (Count-Min heavy-hitter row hashes) salts the
+    rows of hot KEYS round-robin over all devices BEFORE the exchange —
+    legal only on a raw-row exchange ahead of a partial->final pair
+    whose accumulators are partition-invariant (legality.
+    strategy_verdict), where spreading one key over many partials is
+    re-merged exactly by the final; a 64-bit hash collision merely
+    salts one cold key too, which the same invariance makes harmless.
     """
 
     keys: Tuple[E.Expression, ...]
@@ -113,6 +129,7 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
     slice_capacity: Optional[int] = None
     out_capacity: Optional[int] = None
     fan_destinations: Optional[Tuple[int, ...]] = None
+    presplit_hashes: Optional[Tuple[int, ...]] = None
     traceable = True
 
     def children(self):
@@ -142,7 +159,20 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
         return tvs
 
     def _target(self, pipe: Pipe, d: int) -> jnp.ndarray:
-        target = X.hash_target(self._key_tvs(pipe), pipe.mask, d)
+        key_tvs = self._key_tvs(pipe)
+        target = X.hash_target(key_tvs, pipe.mask, d)
+        if self.presplit_hashes:
+            h = X.hash_rows(key_tvs)
+            hot = jnp.zeros(h.shape, dtype=jnp.bool_)
+            for ph in self.presplit_hashes:
+                hot = hot | (h == jnp.uint64(np.uint64(ph)))
+            hot = hot & pipe.mask
+            # hot rows round-robin over ALL devices, offset by the
+            # source device so the d salted streams interleave instead
+            # of marching in lockstep onto the same destinations
+            rank = jnp.cumsum(hot.astype(jnp.int32)) - 1
+            salted = ((rank + X.axis_index()) % d).astype(jnp.int32)
+            target = jnp.where(hot, salted, target)
         if self.fan_destinations:
             target = X.fan_local(target, self.fan_destinations)
         return target
@@ -159,7 +189,7 @@ class HashPartitionExchangeExec(P.PhysicalPlan):
         return ("HashExchange", tuple(E.expr_key(k) for k in self.keys),
                 self.key_union_dicts, self.slice_capacity,
                 self.out_capacity, self.fan_destinations,
-                self.child.plan_key())
+                self.presplit_hashes, self.child.plan_key())
 
 
 @dataclass(eq=False)
@@ -260,11 +290,24 @@ class ExchangeStatsExec(P.PhysicalPlan):
       per-key value min/max (pmin/pmax) and a nulls-present flag over
       the translated key columns — the measured packed-code domain for
       the hash-partial aggregation strategy.
+    - ``cm_depth``/``cm_width`` > 0 add ``__hothash``/``__hotest``: a
+      Count-Min heavy-hitter probe over the SAME row hashes routing
+      uses. Each of ``cm_depth`` rows rehashes with a fixed odd seed
+      into a ``cm_width``-wide count table (seg_count local, psum
+      global), the per-row estimate is the min over depths, and each
+      device publishes its local argmax candidate (full 64-bit key
+      hash + global CM estimate) at position ``axis_index`` of the two
+      d-length vectors. The host dedups candidates by hash and elects
+      hot KEYS for pre-splitting (see ``presplit_hashes`` above) —
+      per-key frequency the HLL sketch cannot see, at the cost of
+      2*depth collectives of width ``cm_width``.
     """
 
     exchange: P.PhysicalPlan  # Hash/RoundRobin/Range exchange exec
     sketch_registers: int = 0    # power of two; 0 = no distinct sketch
     key_stats: int = 0           # number of keys to min/max; 0 = none
+    cm_depth: int = 0            # Count-Min hash rows; 0 = no CM probe
+    cm_width: int = 0            # power of two; 0 = no CM probe
     traceable = True
 
     def children(self):
@@ -280,6 +323,9 @@ class ExchangeStatsExec(P.PhysicalPlan):
             fields.append(Field("__kmin", T.INT64, nullable=False))
             fields.append(Field("__kmax", T.INT64, nullable=False))
             fields.append(Field("__knull", T.INT64, nullable=False))
+        if self.cm_depth and self.cm_width:
+            fields.append(Field("__hothash", T.INT64, nullable=False))
+            fields.append(Field("__hotest", T.INT64, nullable=False))
         return Schema(tuple(fields))
 
     def trace(self, child_pipes: List[Pipe]) -> Pipe:
@@ -300,7 +346,8 @@ class ExchangeStatsExec(P.PhysicalPlan):
                 "__maxslice": TV(padded(maxslice), None, T.INT64, None)}
         order = ["__incoming", "__maxslice"]
 
-        if self.sketch_registers or self.key_stats:
+        if self.sketch_registers or self.key_stats or \
+                (self.cm_depth and self.cm_width):
             key_tvs = self.exchange._key_tvs(pipe)
 
         if self.sketch_registers:
@@ -349,6 +396,33 @@ class ExchangeStatsExec(P.PhysicalPlan):
                                  T.INT64, None)
             order += ["__kmin", "__kmax", "__knull"]
 
+        if self.cm_depth and self.cm_width:
+            w = int(self.cm_width)               # power of two (caller)
+            h = X.hash_rows(key_tvs)
+            est = None
+            for seed in _CM_SEEDS[:int(self.cm_depth)]:
+                hj = K.hash64(h ^ jnp.uint64(seed))
+                idx = (hj & jnp.uint64(w - 1)).astype(jnp.int32)
+                table = X.psum(K.seg_count(idx, pipe.mask, w))
+                e = table[idx]
+                est = e if est is None else jnp.minimum(est, e)
+            # dead rows estimate -1 so the argmax candidate is a live
+            # row whenever one exists; the host drops est <= 0 anyway
+            est = jnp.where(pipe.mask, est, jnp.int64(-1))
+            cand = jnp.argmax(est)
+            # each device publishes (key hash, CM estimate) of its own
+            # candidate at position axis_index via a one-hot psum — the
+            # whole mesh's candidate list in one d-length pair
+            slot = jnp.arange(cap) == X.axis_index()
+            zero = jnp.int64(0)
+            cols["__hothash"] = TV(
+                X.psum(jnp.where(slot, h[cand].astype(jnp.int64), zero)),
+                None, T.INT64, None)
+            cols["__hotest"] = TV(
+                X.psum(jnp.where(slot, est[cand], zero)),
+                None, T.INT64, None)
+            order += ["__hothash", "__hotest"]
+
         # replicated reductions: keep device 0's copy live, like
         # PSumAggExec, so the result reads back once
         keep = X.axis_index() == 0
@@ -360,7 +434,7 @@ class ExchangeStatsExec(P.PhysicalPlan):
 
     def plan_key(self):
         return ("ExchangeStats", self.sketch_registers, self.key_stats,
-                self.exchange.plan_key())
+                self.cm_depth, self.cm_width, self.exchange.plan_key())
 
 
 @dataclass(eq=False)
@@ -679,6 +753,28 @@ class DistSortAggExec(P.PhysicalPlan):
         return ("DistSortAgg", tuple(E.expr_key(g) for g in self.groupings),
                 tuple(E.expr_key(a) for a in self.aggregates),
                 self.phase, self.child.plan_key())
+
+
+@dataclass(eq=False)
+class DistRangeAggExec(DistSortAggExec):
+    """The sort-based aggregation rung's final: the identical local
+    sort-and-segment merge as DistSortAggExec, but the executor plans
+    it over a RANGE exchange on the group keys instead of a hash
+    exchange, so device order == global key order and the per-device
+    lexsort completes a distributed global sort — the aggregate's
+    output is key-ordered across the whole mesh for free, and a
+    matching downstream global Sort collapses to a no-op (the executor
+    marks the result batch ``sorted_by``; the sort-vs-hash trade of
+    'sort-based group-by produces ordered output as a byproduct'). A
+    distinct node so plan/trace cache keys and EXPLAIN output
+    distinguish the rung from an ordinary hash-routed DistSortAgg."""
+
+    def node_string(self):
+        return (f"DistRangeAgg[keys=[{', '.join(map(str, self.groupings))}],"
+                f" out=[{', '.join(str(e) for e in self.aggregates)}]]")
+
+    def plan_key(self):
+        return ("DistRangeAgg",) + super().plan_key()[1:]
 
 
 @dataclass(eq=False)

@@ -32,9 +32,11 @@ from spark_tpu.types import Schema
 class ShardedBatch:
     """schema + BatchData whose arrays are (D*cap,) sharded on ``data``."""
 
-    __slots__ = ("schema", "data", "mesh", "per_device_capacity")
+    __slots__ = ("schema", "data", "mesh", "per_device_capacity",
+                 "sorted_by")
 
-    def __init__(self, schema: Schema, data: BatchData, mesh: Mesh):
+    def __init__(self, schema: Schema, data: BatchData, mesh: Mesh,
+                 sorted_by=None):
         self.schema = schema
         self.data = data
         self.mesh = mesh
@@ -42,6 +44,15 @@ class ShardedBatch:
         total = int(data.row_mask.shape[0])
         assert total % d == 0, (total, d)
         self.per_device_capacity = total // d
+        #: global order guarantee, or None: a tuple of
+        #: (column_name, ascending, nulls_first) the FLAT ROW ORDER of
+        #: this batch already satisfies across the whole mesh (e.g. the
+        #: sort-based aggregation rung's range-partitioned, locally
+        #: sorted output). Consumers (the executor's sort/range-
+        #: exchange elision) may skip a global sort whose orders are a
+        #: prefix-compatible match; purely advisory — dropping it is
+        #: always correct.
+        self.sorted_by = sorted_by
 
     @property
     def capacity(self) -> int:

@@ -828,7 +828,7 @@ _RECURSE_MIN_ROWS = 4096
 
 #: HLL registers for the host-side distinct sketch maintained during
 #: the hybrid join's partition pass (same estimator as the adaptive
-#: aggregation sketch — parallel/executor.hll_estimate)
+#: aggregation sketch — one shared implementation in spark_tpu/sketch.py)
 _HLL_REGISTERS = 256
 
 
@@ -843,19 +843,6 @@ def _session_memory_manager():
         return getattr(sess, "memory_manager", None)
     except Exception:
         return None
-
-
-def _hll_update(registers: np.ndarray, vals: np.ndarray) -> None:
-    """Fold one chunk's join-key values into the HLL registers, host
-    side: register index from the hash's low bits, rank from the
-    leading-zero count of the remaining 56 bits (via float log2 — a
-    +/-1 rank error near powers of two is noise for a sketch)."""
-    h = vals.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
-    idx = (h & np.uint64(_HLL_REGISTERS - 1)).astype(np.int64)
-    rest = (h >> np.uint64(8)).astype(np.float64)
-    msb = np.floor(np.log2(np.maximum(rest, 1.0)))
-    rank = np.where(rest > 0, 56.0 - msb, 57.0).astype(np.int64)
-    np.maximum.at(registers, idx, rank)
 
 
 class _HybridSpillAbort(Exception):
@@ -1001,8 +988,8 @@ class _HybridHashJoinAgg:
         from spark_tpu.columnar.arrow import arrow_to_numpy
         from spark_tpu.columnar.batch import from_numpy, round_capacity
         from spark_tpu.io.datasource import _pa_schema_from_schema
-        from spark_tpu.parallel.executor import hll_estimate
         from spark_tpu.physical.pipeline import ChunkPipeline
+        from spark_tpu.sketch import HyperLogLog
         from spark_tpu.scheduler import admission
 
         budget = conf.get(MAX_DEVICE_BATCH_BYTES)
@@ -1032,7 +1019,7 @@ class _HybridHashJoinAgg:
 
         parts_a = [_HybridPart() for _ in range(nparts)]
         parts_b = [_HybridPart() for _ in range(nparts)]
-        registers = np.zeros(_HLL_REGISTERS, dtype=np.int64)
+        hll = HyperLogLog(_HLL_REGISTERS)
         counters = {"resident": 0, "staged": 0, "spill_bytes": 0,
                     "max_depth": 0}
         tmpdir: Optional[str] = None
@@ -1088,7 +1075,7 @@ class _HybridHashJoinAgg:
                     raise NotImplementedError(
                         "hybrid hash join needs an integral "
                         "partition key")
-                _hll_update(registers, vals)
+                hll.update(vals)
                 h = ((vals.astype(np.uint64) * self._MIX)
                      >> np.uint64(32)) % np.uint64(nparts)
                 h = h.astype(np.int64)
@@ -1287,7 +1274,7 @@ class _HybridHashJoinAgg:
             staged_bytes=counters["staged"],
             spill_bytes=counters["spill_bytes"],
             depth=counters["max_depth"],
-            ndv=int(hll_estimate(registers)),
+            ndv=int(hll.estimate()),
             chunks=state.chunks, pipeline_depth=depth,
             **stats.finish())
         # AQE feedback: the NEXT run of this plan shape requests a

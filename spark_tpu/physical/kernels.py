@@ -216,6 +216,16 @@ def seg_sum(data, seg, mask, num_segments: int, sorted_seg: bool = False):
         # global aggregate: a plain reduction beats a 1-segment scatter-add
         # (this is the AggregateBenchmark 'agg w/o group' hot path)
         return jnp.sum(masked)[None]
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # float addition rounds per combination-tree shape, and every
+        # tree-structured reduction here (cumsum difference, masked
+        # jnp.sum) takes its shape from the PADDED array length — so a
+        # segment's float sum would come out bit-different between the
+        # static and the AQE capacity-compacted layouts of the same
+        # rows. XLA scatter-add applies updates in row order: the sum
+        # depends only on the segment's own rows, byte-stable across
+        # layouts (int/decimal sums are exact and keep the fast paths).
+        return jax.ops.segment_sum(masked, seg, num_segments=num_segments)
     if num_segments <= _MASKED_SEG_LIMIT:
         return _masked_reduce(data, seg, mask, num_segments, jnp.sum, zero)
     if not sorted_seg:

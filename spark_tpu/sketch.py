@@ -28,6 +28,69 @@ def _column_hashes(values, seeds: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(lambda s: K.hash64(x ^ s))(seeds)
 
 
+def hll_estimate(registers: np.ndarray) -> float:
+    """HyperLogLog distinct estimate from register maxima: harmonic
+    mean alpha_m * m^2 / sum(2^-M_j), with the standard linear-counting
+    correction (m * ln(m / V), V = zero registers) in the small range
+    where raw HLL biases high (Flajolet et al. 2007, the same
+    corrections the reference's HyperLogLogPlusPlusHelper applies).
+
+    The ONE estimator every HLL in the engine shares: the device-side
+    group-key sketch traced by the adaptive-aggregation stats stage
+    (parallel/operators.ExchangeStatsExec), the hybrid hash join's
+    host-side partition oracle (physical/chunked.py), and the host
+    ``HyperLogLog`` below all produce register maxima in this shape."""
+    m = int(registers.size)
+    if m == 0:
+        return 0.0
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / float(
+        np.sum(np.power(2.0, -registers.astype(np.float64))))
+    zeros = int((registers == 0).sum())
+    if est <= 2.5 * m and zeros:
+        est = m * math.log(m / zeros)
+    return float(est)
+
+
+class HyperLogLog:
+    """Host-side HLL over int64 columns, parameterized by register
+    count (power of two). Register index comes from the hash's low p
+    bits, rank from the leading-zero count of the remaining 64-p bits
+    (via float log2 — a +/-1 rank error near powers of two is noise
+    for a sketch). The same construction the device sketch traces with
+    jnp (ExchangeStatsExec), so one oracle test covers both shapes.
+    Merging is elementwise max, like the reference's
+    HyperLogLogPlusPlusHelper partial merge."""
+
+    _MIX = np.uint64(0x9E3779B97F4A7C15)
+
+    def __init__(self, registers: int = 256):
+        assert registers >= 2 and registers & (registers - 1) == 0, \
+            registers
+        self.m = int(registers)
+        self.p = self.m.bit_length() - 1
+        self.registers = np.zeros(self.m, dtype=np.int64)
+
+    def update(self, vals: np.ndarray) -> None:
+        """Fold one chunk of int64 values into the registers."""
+        h = np.asarray(vals).astype(np.uint64) * self._MIX
+        idx = (h & np.uint64(self.m - 1)).astype(np.int64)
+        rest = (h >> np.uint64(self.p)).astype(np.float64)
+        nbits = 64 - self.p
+        msb = np.floor(np.log2(np.maximum(rest, 1.0)))
+        rank = np.where(rest > 0, nbits - msb, nbits + 1).astype(np.int64)
+        np.maximum.at(self.registers, idx, rank)
+
+    def merge(self, other: "HyperLogLog") -> "HyperLogLog":
+        assert self.m == other.m
+        out = HyperLogLog(self.m)
+        out.registers = np.maximum(self.registers, other.registers)
+        return out
+
+    def estimate(self) -> float:
+        return hll_estimate(self.registers)
+
+
 class CountMinSketch:
     """Conservative frequency estimation: depth x width counters;
     estimate = min over rows (never under-counts)."""

@@ -72,6 +72,9 @@ SPAN_NAMES = frozenset({
     "stage.run",            # one physical stage (host glue + device)
     "stage.device",         # device execution, block_until_ready-bounded
     "exchange.stats",       # AQE host round-trip fetching device stats
+    "agg.decide",           # adaptive-agg sketch fetch + strategy pick
+    "agg.sort",             # sort rung: range exchange + sorted merge
+    "agg.presplit",         # hot-key pre-split: salted exchange + merge
     "pipeline.decode",      # chunk pipeline: one chunk decode+filter
     "pipeline.transfer",    # chunk pipeline: one chunk host->device
     "fault.retry",          # one recovery re-attempt after a fault

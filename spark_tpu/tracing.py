@@ -661,6 +661,7 @@ def aggregation_profile(events: Optional[List[dict]] = None
             "ndv": int(e.get("ndv", 0)), "rows": int(e.get("rows", 0)),
             "ratio": round(float(e.get("ratio", 0.0)), 4),
             "domain": int(e.get("domain", 0)),
+            "hot_keys": int(e.get("hot_keys", 0) or 0),
             "devices": int(e.get("devices", 0))})
     return {"strategies": strategies, "modes": modes,
             "recent": recent[-16:], "totals": metrics.agg_stats()}
@@ -677,18 +678,22 @@ def format_aggregation_profile(
     lines = [
         f"strategy picks: {s.get('partial', 0)} partial->final, "
         f"{s.get('bypass', 0)} partial-bypass, "
-        f"{s.get('hash', 0)} hash-partial",
+        f"{s.get('hash', 0)} hash-partial, "
+        f"{s.get('sort', 0)} sort-merge, "
+        f"{s.get('presplit', 0)} hot-key-presplit",
         f"decisions: {m.get('auto', 0)} auto (sketch), "
         f"{m.get('forced', 0)} conf-forced, "
         f"{m.get('pinned', 0)} legality-pinned, "
         f"{m.get('fallback', 0)} sketch-fault fallbacks "
         f"({t.get('sketch_failures', 0)} lifetime)"]
     if p.get("recent"):
-        lines.append("strategy  mode      ndv~      rows  ratio domain")
+        lines.append(
+            "strategy  mode      ndv~      rows  ratio domain hot")
         for r in p["recent"][-8:]:
             lines.append(
                 f"{r['strategy']:<9} {r['mode']:<8} {r['ndv']:>6} "
-                f"{r['rows']:>9} {r['ratio']:>6.2f} {r['domain']:>6}")
+                f"{r['rows']:>9} {r['ratio']:>6.2f} {r['domain']:>6} "
+                f"{r.get('hot_keys', 0):>3}")
     return "\n".join(lines)
 
 

@@ -100,8 +100,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "agg: runtime-adaptive aggregation — cardinality-sketched "
-        "strategy switching (partial->final / bypass / hash-partial), "
-        "Pallas segmented reductions, byte-identity sweeps")
+        "strategy switching (partial->final / bypass / hash-partial / "
+        "sort / hot-key presplit), Count-Min heavy hitters, Pallas "
+        "segmented reductions, byte-identity sweeps")
     config.addinivalue_line(
         "markers",
         "trace: end-to-end query tracing (spark_tpu/trace/) — "

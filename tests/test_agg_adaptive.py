@@ -6,9 +6,12 @@ the strategy per aggregate AT RUNTIME).
 The hard invariant under test: every strategy the switch can pick —
 partial->final (the static plan), partial-bypass (raw rows exchanged
 straight to the final aggregate), hash-partial (measured packed-code
-domain) — produces BYTE-IDENTICAL results to the static plan, across
-device counts, key distributions, key types, forced and auto modes,
-and under injected sketch faults of every kind.
+domain), the sort rung (range exchange + sorted segmented merge,
+key-ordered output), and hot-key pre-splitting (Count-Min heavy
+hitters salted over all devices before the exchange) — produces
+BYTE-IDENTICAL results to the static plan, across device counts, key
+distributions, key types, forced and auto modes, and under injected
+sketch/presplit faults of every kind.
 """
 
 import numpy as np
@@ -70,6 +73,13 @@ def _dataset(dist, rng, n=3000):
     elif dist == "skewed":
         keys = np.where(rng.random(n) < 0.9, 7,
                         rng.integers(0, 5000, n))
+    elif dist == "hot":
+        # one heavy hitter riding a near-distinct huge-domain tail:
+        # high NDV ratio + unpackable domain puts the crossover on a
+        # raw-row-exchange strategy (sort), exactly where a hot key
+        # imbalances the exchange and the Count-Min probe pre-splits
+        keys = np.where(np.arange(n) % 3 == 0, 7,
+                        np.arange(n, dtype=np.int64) * 1_000_003)
     else:  # all-distinct: NDV == rows, pre-aggregation is pure waste
         keys = np.arange(n)
     return _table(keys, rng.integers(0, 1000, n))
@@ -83,15 +93,21 @@ def _agg_events():
 
 
 @pytest.mark.parametrize("devices", [1, 2, 8])
-@pytest.mark.parametrize("dist", ["uniform", "skewed", "distinct"])
+@pytest.mark.parametrize("dist", ["uniform", "skewed", "distinct",
+                                  "hot"])
 @pytest.mark.timeout(300)
 def test_byte_identity_strategy_sweep(devices, dist, rng):
     plan = _agg_plan(_dataset(dist, rng))
     off = _rows(_executor(devices, False).execute_logical(plan))
-    for strategy in ("auto", "partial", "bypass", "hash"):
+    for strategy in ("auto", "partial", "bypass", "hash", "sort",
+                     "presplit"):
+        # presplit thresholds low enough that (hot, d=8) genuinely
+        # pre-splits instead of degrading everywhere
         on = _rows(_executor(
             devices, True,
-            **{"spark.tpu.adaptive.agg.strategy": strategy},
+            **{"spark.tpu.adaptive.agg.strategy": strategy,
+               "spark.tpu.adaptive.agg.presplitMinRows": 64,
+               "spark.tpu.adaptive.agg.presplitFactor": 2},
         ).execute_logical(plan))
         assert on == off, (devices, dist, strategy)
 
@@ -107,7 +123,39 @@ def test_byte_identity_string_keys(rng):
     })))
     plan = _agg_plan(rel)
     off = _rows(_executor(2, False).execute_logical(plan))
-    for strategy in ("auto", "partial", "bypass", "hash"):
+    for strategy in ("auto", "partial", "bypass", "hash", "sort",
+                     "presplit"):
+        on = _rows(_executor(
+            2, True, **{"spark.tpu.adaptive.agg.strategy": strategy},
+        ).execute_logical(plan))
+        assert on == off, strategy
+
+
+@pytest.mark.timeout(300)
+def test_byte_identity_compound_string_key(rng):
+    # a dictionary string key alone always takes the static packed-key
+    # direct path; pairing it with an int key defeats that, so a STRING
+    # key rides through every strategy cell of the runtime switch
+    # (including the sort rung, whose output must NOT claim a global
+    # string order: codes sort locally, ranks partition globally)
+    n = 2000
+    ik = np.arange(n, dtype=np.int64) * 1_000_003
+    words = [f"w{i % 37}" for i in range(n)]
+    rel = L.Relation(from_arrow(pa.table({
+        "k": pa.array(ik, pa.int64()),
+        "s": pa.array(words, pa.string()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })))
+    v = E.Col("v")
+    plan = L.Sort(
+        (E.SortOrder(E.Col("k")), E.SortOrder(E.Col("s"))),
+        L.Aggregate(
+            (E.Col("k"), E.Col("s")),
+            (E.Col("k"), E.Col("s"), E.Alias(E.Sum(v), "sv"),
+             E.Alias(E.Count(v), "n")), rel))
+    off = _rows(_executor(2, False).execute_logical(plan))
+    for strategy in ("auto", "partial", "bypass", "hash", "sort",
+                     "presplit"):
         on = _rows(_executor(
             2, True, **{"spark.tpu.adaptive.agg.strategy": strategy},
         ).execute_logical(plan))
@@ -152,6 +200,105 @@ def test_auto_falls_back_to_partial_on_wide_domain(rng):
               ).execute_logical(plan)
     ev = _agg_events()[-1]
     assert ev["strategy"] == "partial" and ev["mode"] == "auto"
+
+
+@pytest.mark.timeout(300)
+def test_auto_picks_sort_on_huge_domain(rng):
+    # NDV ~ rows AND the packed domain far beyond sortDomainWidth: the
+    # crossover picks the sort rung, whose key-ordered output then
+    # elides the downstream global sort entirely
+    n = 3000
+    keys = np.arange(n, dtype=np.int64) * 1_000_003
+    plan = _agg_plan(_table(keys, rng.integers(0, 1000, n)))
+    off = _rows(_executor(2, False).execute_logical(plan))
+    metrics.reset_agg()
+    on = _rows(_executor(2, True).execute_logical(plan))
+    assert on == off
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "sort" and ev["mode"] == "auto"
+    assert ev["ratio"] >= 0.5 and ev["domain"] > (1 << 20)
+    st = metrics.agg_stats()
+    assert st["sort"] == 1 and st["sort_elided"] == 1
+
+
+@pytest.mark.timeout(300)
+def test_auto_picks_presplit_on_hot_key(rng):
+    # one key is half of all rows over an otherwise near-distinct
+    # huge-domain tail: the crossover would exchange raw rows (sort
+    # rung) and the Count-Min probe sees a heavy hitter whose
+    # frequency alone overloads a device — so it pre-splits the key
+    # over the whole mesh BEFORE the exchange
+    plan = _agg_plan(_dataset("hot", rng))
+    off = _rows(_executor(8, False).execute_logical(plan))
+    metrics.reset_agg()
+    on = _rows(_executor(
+        8, True, **{"spark.tpu.adaptive.agg.presplitMinRows": 64,
+                    "spark.tpu.adaptive.agg.presplitFactor": 2},
+    ).execute_logical(plan))
+    assert on == off
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "presplit" and ev["mode"] == "auto"
+    assert ev["hot_keys"] >= 1
+    assert metrics.agg_stats()["presplit"] == 1
+
+
+@pytest.mark.timeout(300)
+def test_auto_keeps_partial_on_low_ndv_skew(rng):
+    # 90% one key but LOW NDV ratio: the partial strategy collapses
+    # the hot key to one row per device before its exchange, so
+    # pre-splitting would only add an extra raw-row exchange — the
+    # ladder must keep the crossover's partial pick
+    plan = _agg_plan(_dataset("skewed", rng))
+    metrics.reset_agg()
+    _executor(8, True,
+              **{"spark.tpu.adaptive.agg.presplitMinRows": 64},
+              ).execute_logical(plan)
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["mode"] == "auto"
+    assert ev["hot_keys"] >= 1  # detected, deliberately not acted on
+
+
+@pytest.mark.timeout(300)
+def test_forced_presplit_degrades_without_hot_keys(rng):
+    # uniform keys have no heavy hitter: forcing presplit degrades to
+    # the static plan instead of salting cold keys
+    plan = _agg_plan(_dataset("uniform", rng))
+    off = _rows(_executor(2, False).execute_logical(plan))
+    metrics.reset_agg()
+    on = _rows(_executor(
+        2, True, **{"spark.tpu.adaptive.agg.strategy": "presplit"},
+    ).execute_logical(plan))
+    assert on == off
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial" and ev["mode"] == "forced"
+
+
+@pytest.mark.timeout(60)
+def test_strategy_crossover_boundary_cells():
+    """The pure crossover rule the runtime switch, its EXPLAIN
+    diagnostic and these cells all share — pinned exactly at the
+    conf-documented boundaries."""
+    from spark_tpu.analysis.legality import strategy_crossover
+
+    bypass_r, hash_w, sort_w = 0.5, 1024, 1 << 20
+
+    def cell(ratio, width):
+        return strategy_crossover(ratio, width, bypass_r, hash_w,
+                                  sort_w)
+
+    # the four corners of the matrix
+    assert cell(0.1, 100) == "hash"
+    assert cell(0.1, hash_w + 1) == "partial"
+    assert cell(0.9, sort_w) == "bypass"
+    assert cell(0.9, sort_w + 1) == "sort"
+    # boundary cells: ratio threshold inclusive, width limits inclusive
+    assert cell(bypass_r, sort_w) == "bypass"
+    assert cell(float(np.nextafter(bypass_r, 0)), 100) == "hash"
+    assert cell(0.9, hash_w) == "bypass"
+    assert cell(0.1, hash_w) == "hash"
+    # unbounded/unpackable domain (-1): string keys, overflowing packs
+    assert cell(0.9, -1) == "sort"
+    assert cell(0.1, -1) == "partial"
 
 
 @pytest.mark.timeout(300)
@@ -226,6 +373,48 @@ def test_hll_estimate_accuracy(true_ndv):
     np.maximum.at(regs, idx, rho.astype(np.int64))
     est = MeshExecutor._hll_estimate(regs)
     assert abs(est - true_ndv) <= max(4, 4 * 1.04 / np.sqrt(m) * true_ndv)
+
+
+@pytest.mark.parametrize("true_ndv", [64, 3000])
+@pytest.mark.timeout(300)
+def test_hyperloglog_host_class_accuracy(true_ndv):
+    """The consolidated host HyperLogLog (spark_tpu/sketch.py) against
+    exact distinct counts, including the chunked-update + merge path
+    the hybrid hash join's partition oracle uses."""
+    from spark_tpu.sketch import HyperLogLog
+
+    rng = np.random.default_rng(true_ndv)
+    vals = rng.choice(1 << 40, true_ndv, replace=False).astype(np.int64)
+    a, b = HyperLogLog(512), HyperLogLog(512)
+    a.update(vals[: true_ndv // 2])
+    b.update(vals[true_ndv // 3:])          # overlapping chunks
+    est = a.merge(b).estimate()
+    assert abs(est - true_ndv) <= max(8, 4 * 1.04 / np.sqrt(512)
+                                      * true_ndv)
+
+
+@pytest.mark.parametrize("width", [64, 256])
+@pytest.mark.timeout(300)
+def test_countmin_host_oracle_small_widths(width):
+    """Count-Min never under-counts, and at small widths the collision
+    over-count stays within the classic 2N/width bound (x4 slack for
+    the skewed stream) — the property the pre-split threshold relies
+    on: a heavy hitter is never missed, a cold key is at worst salted
+    harmlessly."""
+    from spark_tpu.sketch import CountMinSketch
+
+    rng = np.random.default_rng(width)
+    n, k = 20000, 500
+    keys = np.where(rng.random(n) < 0.4, 7,
+                    rng.integers(0, k, n)).astype(np.int64)
+    cm = CountMinSketch(depth=4, width=width).add(keys)
+    uniq, counts = np.unique(keys, return_counts=True)
+    for v, c in zip(uniq[:64], counts[:64]):
+        est = cm.estimate(int(v))
+        assert est >= int(c), (v, est, c)
+        assert est <= int(c) + 4 * (2 * n // width), (v, est, c)
+    hot = int(uniq[np.argmax(counts)])
+    assert hot == 7 and cm.estimate(7) >= int(counts.max())
 
 
 @pytest.mark.timeout(300)
@@ -326,6 +515,29 @@ def test_sketch_fault_falls_back_to_static(kind, rng):
     ev = _agg_events()[-1]
     assert ev["strategy"] == "partial" and ev["mode"] == "fallback"
     assert metrics.agg_stats()["sketch_failures"] == 1
+
+
+@pytest.mark.parametrize("kind", list(faults.KINDS))
+@pytest.mark.timeout(300)
+def test_presplit_fault_falls_back_to_static(kind, rng):
+    """ANY injected fault at agg.presplit — fired after the Count-Min
+    probe elects pre-splitting, before the salted exchange exists —
+    discards the whole candidate list and degrades to the static
+    partial->final plan with identical bytes."""
+    plan = _agg_plan(_dataset("hot", rng))
+    off = _rows(_executor(8, False).execute_logical(plan))
+    metrics.reset_agg()
+    ex = _executor(8, True, **{
+        "spark.tpu.adaptive.agg.presplitMinRows": 64,
+        "spark.tpu.adaptive.agg.presplitFactor": 2,
+        "spark.tpu.faultInjection.agg.presplit": f"nth:1:{kind}"})
+    on = _rows(ex.execute_logical(plan))
+    assert on == off
+    assert faults.fire_count(ex.conf, "agg.presplit") == 1
+    ev = _agg_events()[-1]
+    assert ev["strategy"] == "partial"
+    assert ev["mode"] == "presplit_fallback"
+    assert metrics.agg_stats()["presplit_failures"] == 1
 
 
 # ---- observability ----------------------------------------------------------

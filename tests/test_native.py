@@ -120,23 +120,32 @@ def test_compiler_routes_large_dicts_native(monkeypatch):
 def test_native_speedup_smoke():
     """Not a perf assertion, just evidence the path is worth having:
     C++ should not be slower than Python on a big dictionary. Both
-    sides take the best of 3 runs measured back-to-back in THIS
-    process, so a scheduler hiccup or cold cache on either single
-    measurement cannot flake the comparison."""
+    sides take the MEDIAN of 5 interleaved runs (py, cc, py, cc, ...)
+    so a scheduler hiccup, a GC pause, or noisy-neighbor load during
+    either side's window cannot flake the comparison the way best-of-3
+    back-to-back blocks could; a relative-tolerance floor on top makes
+    the assertion vacuous when both sides finish so fast the timer
+    noise dominates the signal."""
     d = tuple(f"order comment number {i} with padding text" +
               ("special requests" if i % 11 == 0 else "")
               for i in range(50000))
 
-    def best_of_3(fn):
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = fn()
-            times.append(time.perf_counter() - t0)
-        return out, min(times)
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
 
-    want, t_py = best_of_3(lambda: _py_like(d, "%special%requests%"))
-    got, t_cc = best_of_3(
-        lambda: native.like_table(d, "%special%requests%"))
-    np.testing.assert_array_equal(got, want)
-    assert t_cc < t_py * 2  # wildly conservative; typically 10-50x faster
+    py = lambda: _py_like(d, "%special%requests%")  # noqa: E731
+    cc = lambda: native.like_table(d, "%special%requests%")  # noqa: E731
+    t_pys, t_ccs = [], []
+    for _ in range(5):  # interleaved: ambient load hits both sides
+        want, t = timed(py)
+        t_pys.append(t)
+        got, t = timed(cc)
+        t_ccs.append(t)
+        np.testing.assert_array_equal(got, want)
+    t_py = sorted(t_pys)[2]
+    t_cc = sorted(t_ccs)[2]
+    # 2x slack + a 5ms absolute floor: when both medians sit inside
+    # timer/scheduler noise there is no speedup signal to assert on
+    assert t_cc < max(t_py * 2, t_py + 0.005), (t_cc, t_py)
