@@ -108,8 +108,8 @@ def _query_deadline(extra_s: float = 0.0, cap_s: float = None) -> float:
 # sized ~3x their observed worst case instead.
 PHASE_BUDGET_S = {
     "cached": 180.0, "adaptive": 240.0, "serving": 240.0,
-    "serve": 240.0, "mview": 180.0, "agg": 420.0, "join": 420.0,
-    "trace": 150.0,
+    "serve": 240.0, "fleet": 240.0, "mview": 180.0, "agg": 420.0,
+    "join": 420.0, "trace": 150.0,
 }
 
 
@@ -179,6 +179,12 @@ JOIN_MODE = os.environ.get("BENCH_JOIN", "1") == "1"
 # + the host/device/queue/transfer breakdown of one traced q3 land
 # under 'trace' in the result JSON)
 TRACE_MODE = os.environ.get("BENCH_TRACE", "1") == "1"
+
+# BENCH_FLEET=0 skips the fleet scaling sweep (QPS vs replica count on
+# NON-cacheable unique-plan traffic over a sharded dataset with
+# shard-ownership routing on; per-cell byte-identity against the
+# 1-replica cell lands under 'fleet' in the result JSON)
+FLEET_MODE = os.environ.get("BENCH_FLEET", "1") == "1"
 
 
 def _warmup_child() -> None:
@@ -556,6 +562,145 @@ def _run_serve_ab(spark, concurrency: int, replicas_n: int,
     return out
 
 
+def _run_fleet_bench(spark, concurrency: int = 4,
+                     cells: tuple = (1, 2, 4),
+                     tables: int = 4, rows_per_table: int = 50_000,
+                     queries_per_table: int = 12) -> dict:
+    """Fleet scaling sweep (spark_tpu/serve/ownership.py): QPS vs
+    replica count on NON-cacheable traffic — every request is a unique
+    plan (a fresh literal), so the result cache never hits and the
+    number measures the ownership-routed data plane, not memoization.
+    The dataset is sharded across ``tables`` parquet tables so the
+    rendezvous map spreads owners across the fleet. Every cell replays
+    the SAME seeded query list; cells >1 are checked byte-identical
+    against the 1-replica cell per query — a QPS curve that changes
+    bytes with the replica count would be worse than no number."""
+    import shutil
+    import tempfile
+    import threading
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_tpu import metrics as _metrics
+    from spark_tpu.connect.server import Client
+    from spark_tpu.serve import serve_fleet
+
+    d = tempfile.mkdtemp(prefix="bench_fleet_")
+    rng = np.random.default_rng(1234)
+    for t in range(tables):
+        i = np.arange(rows_per_table)
+        pq.write_table(pa.table({
+            "s": pa.array((i % 53).astype(np.int64)),
+            "v": pa.array(((i * 7919 + t) % 100_003).astype(np.int64)),
+        }), os.path.join(d, f"shard{t}.parquet"))
+        (spark.read.parquet(os.path.join(d, f"shard{t}.parquet"))
+         .createOrReplaceTempView(f"fleet_b{t}"))
+    # one seeded unique-literal query list, identical across cells
+    cuts = rng.integers(0, 100_003, size=tables * queries_per_table)
+    qlist = [
+        (f"SELECT s, SUM(v) AS sv, COUNT(*) AS n FROM fleet_b{j % tables} "
+         f"WHERE v >= {int(cuts[j])} GROUP BY s")
+        for j in range(tables * queries_per_table)]
+    spark.conf.set("spark.tpu.serve.ownership.enabled", True)
+    spark.conf.set("spark.tpu.serve.resultCache.enabled", True)
+    # warm-up off the clock: the query shape compiles ONCE per table;
+    # without this the 1-replica cell absorbs all XLA compile time and
+    # the scaling curve flatters the fleet
+    for t in range(tables):
+        spark.sql(qlist[t]).toArrow()
+    reference: dict = {}
+
+    def cell(n_replicas: int) -> dict:
+        fleet = serve_fleet(spark, replicas=n_replicas)
+        lock = threading.Lock()
+        latencies, mismatched, errors = [], [], []
+        next_q = [0]
+        try:
+            fleet.router.federation.probe(force=True)  # learn shards
+
+            def worker() -> None:
+                c = Client(fleet.url, timeout=QUERY_TIMEOUT_S)
+                while True:
+                    with lock:
+                        j = next_q[0]
+                        if j >= len(qlist):
+                            return
+                        next_q[0] += 1
+                    t0 = time.perf_counter()
+                    try:
+                        tbl = c.sql(qlist[j])
+                    except Exception as e:
+                        with lock:
+                            errors.append(
+                                f"q{j}: {type(e).__name__}: {e}")
+                        continue
+                    lat_ms = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        latencies.append(lat_ms)
+                        if n_replicas == cells[0]:
+                            reference[j] = tbl
+                        else:
+                            ref = reference.get(j)
+                            if ref is None or not tbl.equals(ref):
+                                mismatched.append(j)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(concurrency)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.perf_counter() - t0
+        finally:
+            fleet.stop()
+        snap = _metrics.serve_stats()
+        return {
+            "replicas": n_replicas,
+            "queries_completed": len(latencies),
+            "errors": errors[:10],
+            "wall_s": round(wall_s, 2),
+            "qps": round(len(latencies) / wall_s, 2) if wall_s else 0.0,
+            "p50_ms": round(_percentile(latencies, 50), 1),
+            "p95_ms": round(_percentile(latencies, 95), 1),
+            "byte_identical_to_single_replica": (
+                not mismatched and not errors),
+            "mismatched_queries": sorted(set(mismatched))[:10],
+            "cache_hits": snap.get("hits", 0),
+            "epoch_mints": snap.get("epoch_mints", 0),
+        }
+
+    out = {"concurrency": concurrency,
+           "tables": tables, "queries": len(qlist)}
+    try:
+        for n in cells:
+            if _wall_remaining() <= 10:
+                out[f"replicas_{n}"] = {
+                    "error": "skipped: wall budget exhausted"}
+                continue
+            _metrics.reset_serve()
+            out[f"replicas_{n}"] = cell(n)
+        base = out.get(f"replicas_{cells[0]}", {})
+        top = out.get(f"replicas_{cells[-1]}", {})
+        if base.get("qps") and top.get("qps"):
+            out["qps_speedup"] = round(top["qps"] / base["qps"], 2)
+        out["byte_identical_to_single_replica"] = all(
+            out.get(f"replicas_{n}", {}).get(
+                "byte_identical_to_single_replica", False)
+            for n in cells[1:])
+    finally:
+        spark.conf.unset("spark.tpu.serve.ownership.enabled")
+        spark.conf.unset("spark.tpu.serve.resultCache.enabled")
+        cache = getattr(spark, "serve_result_cache", None)
+        if cache is not None:
+            cache.clear()
+        for t in range(tables):
+            spark.catalog.dropTempView(f"fleet_b{t}")
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _run_mview_ab(spark, appends: int = 8, readers: int = 3,
                   base_rows: int = 200_000, delta_rows: int = 1_000,
                   n_keys: int = 64) -> dict:
@@ -891,6 +1036,21 @@ def main():
                 serve_ab = {"error": f"{type(e).__name__}: {e}"}
         _phase_snapshot(serve=serve_ab)
 
+    fleet_bench = None
+    if FLEET_MODE:
+        if _wall_remaining() <= 5:
+            fleet_bench = _budget_skip("fleet")
+        else:
+            print("[bench] fleet scaling: QPS vs replicas {1,2,4}, "
+                  "unique-plan traffic, ownership routing on",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_phase_deadline("fleet")):
+                    fleet_bench = _run_fleet_bench(spark)
+            except Exception as e:
+                fleet_bench = {"error": f"{type(e).__name__}: {e}"}
+        _phase_snapshot(fleet=fleet_bench)
+
     mview = None
     if MVIEW_MODE:
         if _wall_remaining() <= 5:
@@ -995,6 +1155,7 @@ def main():
         **({"adaptive": adaptive} if adaptive is not None else {}),
         **({"serving": serving} if serving is not None else {}),
         **({"serve": serve_ab} if serve_ab is not None else {}),
+        **({"fleet": fleet_bench} if fleet_bench is not None else {}),
         **({"mview": mview} if mview is not None else {}),
         **({"agg": agg_ab} if agg_ab is not None else {}),
         **({"join": join_ab} if join_ab is not None else {}),

@@ -13,7 +13,8 @@ from typing import Optional
 
 import pyarrow as pa
 
-from spark_tpu import deadline, faults, trace
+from spark_tpu import deadline, faults, locks, metrics, trace
+from spark_tpu.serve.ownership import EPOCH_HEADER, EpochRetry
 
 
 class ConnectServer:
@@ -35,6 +36,14 @@ class ConnectServer:
                 result_cache = ResultCache(session.conf)
                 session.serve_result_cache = result_cache
         self.result_cache = result_cache
+        #: highest ownership epoch this replica has adopted (0 until a
+        #: router broadcast or stamped request teaches it one); a
+        #: request stamped with an OLDER epoch is fenced with a typed
+        #: EPOCH_RETRY (409) instead of being served under a stale
+        #: shard->owner view
+        self.fleet_epoch = 0
+        self._epoch_lock = locks.named_lock("serve.ownership")
+        self._owned_shards: set = set()
         #: optional recovery.HeartbeatMonitor surfaced via GET /health;
         #: falls back to one attached to the session
         self.heartbeat = heartbeat if heartbeat is not None \
@@ -65,6 +74,12 @@ class ConnectServer:
                         # echo the trace id so clients can fetch
                         # GET /trace/<id> for the waterfall
                         self.send_header("X-SparkTpu-Trace-Id", tid)
+                    if outer.fleet_epoch:
+                        # every response carries the adopted ownership
+                        # epoch so routers and clients converge on the
+                        # newest fence without a broadcast round trip
+                        self.send_header(EPOCH_HEADER,
+                                         str(outer.fleet_epoch))
                     for k, v in (headers or {}).items():
                         self.send_header(k, v)
                     self.end_headers()
@@ -94,6 +109,34 @@ class ConnectServer:
                     self._send(
                         200, body, "application/json",
                         headers={"X-SparkTpu-Replica": outer.replica_id})
+                elif self.path == "/shards":
+                    # shard report: the federation router learns which
+                    # scan file-sets this replica's catalog serves and
+                    # rendezvous-maps them over the healthy fleet
+                    from spark_tpu.serve.ownership import catalog_shards
+
+                    body = json.dumps(
+                        {"replica": outer.replica_id,
+                         "epoch": outer.fleet_epoch,
+                         "tables":
+                             catalog_shards(outer.session)}).encode()
+                    self._send(200, body, "application/json")
+                elif self.path.startswith("/invalidations"):
+                    # watermark replay for reconnecting caches:
+                    # GET /invalidations?since=<version>
+                    from urllib.parse import parse_qs, urlparse
+
+                    from spark_tpu.serve.ownership import \
+                        session_invalidation_log
+
+                    q = parse_qs(urlparse(self.path).query)
+                    since = int((q.get("since") or ["0"])[0])
+                    log = session_invalidation_log(outer.session)
+                    records, resync = log.since(since)
+                    body = json.dumps(
+                        {"version": log.version, "resync": resync,
+                         "records": records}).encode()
+                    self._send(200, body, "application/json")
                 elif self.path.startswith("/queries"):
                     body = json.dumps(
                         {"status": outer.scheduler.status(),
@@ -133,6 +176,23 @@ class ConnectServer:
                                json.dumps({"cancelled": ok}).encode(),
                                "application/json")
                     return
+                if self.path == "/epoch":
+                    # router broadcast of a freshly minted epoch +
+                    # shard->owner map: adopt it and eagerly rebuild
+                    # any shards this replica just gained
+                    n = int(self.headers.get("Content-Length", "0"))
+                    try:
+                        payload = json.loads(
+                            self.rfile.read(n) or b"{}")
+                        resp = outer._adopt_epoch(payload)
+                        self._send(200, json.dumps(resp).encode(),
+                                   "application/json")
+                    except Exception as e:
+                        self._send(400, json.dumps(
+                            {"error": type(e).__name__,
+                             "message": str(e)}).encode(),
+                            "application/json")
+                    return
                 if self.path == "/lint":
                     # static analysis of a SQL query WITHOUT executing
                     # it: build the lazy DataFrame, analyze, return the
@@ -157,6 +217,26 @@ class ConnectServer:
                     return
                 if self.path not in ("/sql", "/plan"):
                     self._send(404, b"not found", "text/plain")
+                    return
+                stale = outer._fence_epoch(
+                    self.headers.get(EPOCH_HEADER))
+                if stale is not None:
+                    # epoch fence: the sender's shard->owner map
+                    # predates a failover this replica knows about —
+                    # answer typed-retryable instead of serving under
+                    # stale ownership; the router/client re-dispatch
+                    # with a fresh stamp under the unified RetryBudget
+                    err = EpochRetry(*stale)
+                    metrics.note_serve("epoch_fences")
+                    metrics.record("serve", phase="epoch_fence",
+                                   replica=outer.replica_id,
+                                   request_epoch=err.request_epoch,
+                                   fleet_epoch=err.fleet_epoch)
+                    body = json.dumps(
+                        {"error": "EpochRetry",
+                         "message": str(err),
+                         "epoch": err.fleet_epoch}).encode()
+                    self._send(409, body, "application/json")
                     return
                 n = int(self.headers.get("Content-Length", "0"))
                 # adopt the caller's trace (client or federation
@@ -216,13 +296,13 @@ class ConnectServer:
                         # piggyback on an identical in-flight query)
                         # never touches the scheduler at all — the
                         # dispatch+execution cost of a repeated
-                        # dashboard query is one dict lookup
+                        # dashboard query is one dict lookup. The key
+                        # goes through THIS cache's fingerprint probe
+                        # (TTL-amortized under fingerprintCacheSeconds;
+                        # kept fresh by the fleet invalidation log).
                         try:
                             df = build_df()
-                            from spark_tpu.serve.result_cache import \
-                                plan_result_key
-
-                            key = plan_result_key(df._plan)
+                            key = cache.result_key(df._plan)
                         except Exception:
                             key = None  # unkeyable: uncached path
                     if key is not None:
@@ -289,6 +369,99 @@ class ConnectServer:
         #: defaults to the bound port (unique per in-process fleet)
         self.replica_id = replica_id or f"r{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    # -- fleet ownership ------------------------------------------------------
+
+    def _fence_epoch(self, header_value):
+        """None = admit the request; ``(request_epoch, fleet_epoch)``
+        = fence it (typed EPOCH_RETRY). A NEWER stamp is adopted
+        monotonically — the broadcast that should have carried it may
+        have been lost, and the stamp itself is proof the epoch
+        exists."""
+        if header_value is None:
+            return None
+        try:
+            e = int(header_value)
+        except (TypeError, ValueError):
+            return None  # malformed stamp: route by policy, no fence
+        with self._epoch_lock:
+            if e > self.fleet_epoch:
+                self.fleet_epoch = e
+                return None
+            if e < self.fleet_epoch:
+                return (e, self.fleet_epoch)
+        return None
+
+    def _adopt_epoch(self, payload: dict) -> dict:
+        """Adopt a broadcast epoch + owner map; eagerly rebuild any
+        shards this replica just GAINED (the lineage-recompute
+        analogue: state is re-derived from source files, so a lost or
+        failed rebuild only costs latency on the first owned query,
+        never bytes)."""
+        epoch = int(payload.get("epoch", 0))
+        owners = payload.get("owners") or {}
+        shard_paths = payload.get("shards") or {}
+        with self._epoch_lock:
+            if epoch > self.fleet_epoch:
+                self.fleet_epoch = epoch
+            mine = {s for s, rid in owners.items()
+                    if rid == self.replica_id}
+            gained = sorted(mine - self._owned_shards)
+            self._owned_shards = mine
+            fleet = self.fleet_epoch
+        if gained:
+            self._rebuild_shards(gained, shard_paths)
+        return {"replica": self.replica_id, "epoch": fleet,
+                "owned": sorted(mine), "gained": gained}
+
+    def _rebuild_shards(self, gained, shard_paths) -> None:
+        """Warm the dataset + schema of every newly-gained shard from
+        its source files, deadline-capped; ANY failure (including an
+        injected ``serve.ownership`` fault) degrades to lazy rebuild
+        on the first owned query."""
+        from spark_tpu.plan import logical as L
+        from spark_tpu.serve.ownership import (
+            SERVE_OWNERSHIP_REBUILD,
+            SERVE_OWNERSHIP_REBUILD_TIMEOUT_S, shard_key)
+
+        conf = self.session.conf
+        try:
+            if not bool(conf.get(SERVE_OWNERSHIP_REBUILD)):
+                return
+            tmo = float(conf.get(SERVE_OWNERSHIP_REBUILD_TIMEOUT_S))
+        except Exception:
+            return
+        warmed = 0
+        wanted = set(gained)
+        views = getattr(getattr(self.session, "catalog", None),
+                        "_views", None) or {}
+        try:
+            with deadline.bind(deadline.mint(tmo)):
+                faults.inject("serve.ownership", conf)
+                for name, plan in list(views.items()):
+                    scans = L.collect_nodes(plan, L.UnresolvedScan)
+                    if len(scans) != 1:
+                        continue
+                    src = scans[0].source
+                    paths = getattr(src, "paths", None)
+                    if not paths or shard_key(paths) not in wanted:
+                        continue
+                    deadline.check("serve.ownership")
+                    # warm the REAL session-shared source: dataset
+                    # discovery + schema, so the first owned query
+                    # pays only the device execution
+                    src._open()
+                    src.schema()
+                    warmed += 1
+            metrics.note_serve("rebuilds")
+            metrics.record("serve", phase="rebuild",
+                           replica=self.replica_id,
+                           shards=len(gained), warmed=warmed)
+        except Exception as e:
+            metrics.record("fault_recovered", point="serve.ownership",
+                           how="lazy_rebuild",
+                           replica=self.replica_id, warmed=warmed,
+                           error=type(e).__name__)
 
     def start(self) -> "ConnectServer":
         self._thread = threading.Thread(
@@ -379,6 +552,11 @@ class Client:
         #: it via X-SparkTpu-Trace-Id); fetch the waterfall with
         #: ``trace(client.last_trace_id)``
         self.last_trace_id: Optional[str] = None
+        #: metadata of the last completed request: ``replica`` (which
+        #: backend served it), ``cache`` (X-Cache: hit/miss/wait),
+        #: ``epoch`` (the fleet ownership epoch the response carried),
+        #: ``query_id``, ``queue_wait_ms``, ``trace_id``
+        self.last_query: dict = {}
 
     def _jitter(self, attempt: int) -> float:
         import random as _random
@@ -474,6 +652,16 @@ class Client:
                 tid = resp.headers.get("X-SparkTpu-Trace-Id")
                 if tid:
                     self.last_trace_id = tid
+                epoch = resp.headers.get("X-SparkTpu-Epoch")
+                self.last_query = {
+                    "replica": rid,
+                    "cache": resp.headers.get("X-Cache"),
+                    "epoch": int(epoch) if epoch else None,
+                    "query_id": resp.headers.get("X-Query-Id"),
+                    "queue_wait_ms":
+                        resp.headers.get("X-Queue-Wait-Ms"),
+                    "trace_id": tid,
+                }
         except urllib.error.HTTPError as e:
             detail = json.loads(e.read())
             if e.code == 429:
@@ -482,6 +670,15 @@ class Client:
                 raise _RetryableHTTP(
                     f"429 {detail.get('message')}",
                     retry_after_s=float(ra)) from None
+            if e.code == 409:
+                # typed EPOCH_RETRY from an un-routed replica (direct
+                # connection): immediately retryable with no backoff
+                # floor — the fence is about staleness, not load; the
+                # exhaustion error keeps the EPOCH_RETRY marker so it
+                # stays typed for the chaos contract
+                raise _RetryableHTTP(
+                    f"409 {detail.get('message')}",
+                    retry_after_s=0.0) from None
             msg = f"{detail.get('error')}: {detail.get('message')}"
             tb = detail.get("traceback")
             if tb:

@@ -37,6 +37,22 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          different replica; the single-flight result
                          cache guarantees the query still executes at
                          most once per structural key
+- ``serve.ownership``    the fleet ownership-control seams: the
+                         router's per-replica epoch broadcast after a
+                         mint (serve/federation.py) and a replica's
+                         eager rebuild of newly-gained shards
+                         (connect/server.py). ANY kind is absorbed:
+                         a replica that misses the broadcast adopts
+                         the epoch lazily from the next stamped
+                         request, and a failed eager rebuild degrades
+                         to lazy rebuild on first query — ownership
+                         control traffic is advisory, bytes never
+                         depend on it
+- ``serve.invalidate``   a ResultCache applying one invalidation-log
+                         record (serve/result_cache.py): ANY kind
+                         degrades to a FULL cache clear — the planned,
+                         bounded worst case is a cold cache, never a
+                         stale one
 - ``mview.refresh``      one incremental materialized-view refresh
                          (mview/manager.py): transient faults retry up
                          to spark.tpu.mview.refreshRetries, anything
@@ -131,6 +147,8 @@ POINTS = (
     "scheduler.admit",
     "compile.background",
     "serve.dispatch",
+    "serve.ownership",
+    "serve.invalidate",
     "mview.refresh",
     "agg.strategy",
     "agg.presplit",

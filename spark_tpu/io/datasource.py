@@ -186,17 +186,38 @@ class FileSource:
 
         return stat_paths(self.paths)
 
+    def _broadcast_change(self) -> None:
+        """A rewrite/append was just DETECTED on this source: append a
+        ``source_changed`` record to the active session's fleet
+        invalidation log (if one exists) so every replica's TTL'd
+        fingerprint probe and cached results for these paths drop now
+        instead of waiting out the TTL. Strictly best-effort — reads
+        never depend on it."""
+        try:
+            from spark_tpu.api.session import SparkSession
+
+            sess = SparkSession.getActiveSession()
+            log = getattr(sess, "serve_invalidation_log", None) \
+                if sess is not None else None
+            if log is not None:
+                log.append("source_changed", self.paths)
+        except Exception:
+            pass
+
     def _open(self) -> pads.Dataset:
         fp = self._fingerprint()
         if getattr(self, "_fp", None) != fp:
             # underlying files changed: drop dataset + batch/count caches
             # (store entries key on the fingerprint, so they simply
             # stop matching and age out LRU)
+            first = not hasattr(self, "_fp")
             self._dataset = None
             self._cache.clear()
             self._count_cache.clear()
             self._read_counts.clear()
             self._fp = fp
+            if not first:
+                self._broadcast_change()
         if self._dataset is not None:
             return self._dataset
         kwargs: Dict[str, Any] = {}

@@ -52,8 +52,10 @@ LOCK_RANKS: Dict[str, int] = {
     "scheduler.cond": 300,           # QueryScheduler._cond: queue+gate
     "scheduler.pools": 310,          # PoolRegistry._lock
     "pipeline.cond": 350,            # ChunkPipeline._cond: inflight budget
+    "serve.invalidation": 355,       # InvalidationLog ring + subscribers
     "serve.result_cache": 360,       # ResultCache._flights map
     "serve.federation": 370,         # FederationRouter round-robin state
+    "serve.ownership": 372,          # shard->owner map + epoch state
     "serve.breaker": 380,            # per-replica CircuitBreaker window
     "serve.brownout": 385,           # BrownoutController pressure window
     # --- storage / memory manager (inner: leaf data structures) ------

@@ -360,7 +360,9 @@ def reset_exec_store() -> None:
 _SERVE = {"hits": 0, "misses": 0, "waits": 0, "wait_timeouts": 0,
           "dispatches": 0, "sheds": 0, "redispatches": 0,
           "rejected": 0, "replica_failures": 0,
-          "breaker_transitions": 0}
+          "breaker_transitions": 0, "epoch_mints": 0,
+          "epoch_retries": 0, "epoch_fences": 0,
+          "invalidations": 0, "rebuilds": 0}
 
 
 def note_serve(kind: str, n: int = 1) -> None:

@@ -208,6 +208,7 @@ class ViewManager:
                 view.fingerprint = fp
                 view.refreshes += 1
             self._repopulate_serve(view, fresh)
+            self._notify_invalidation(view)
             return fresh
 
     def _refresh(self, view: MaterializedView, cached_batch, kind: str,
@@ -344,6 +345,7 @@ class ViewManager:
                            view=view.name, batch=batch_id,
                            rows=delta_tbl.num_rows)
             self._repopulate_serve(view, batch)
+            self._notify_invalidation(view)
 
     def read(self, name: str):
         """The current state of stream view ``name`` as a DataFrame
@@ -433,6 +435,28 @@ class ViewManager:
                            key=rc.key_digest(key))
         except Exception as exc:  # serve repopulation is best-effort
             metrics.record("mview", phase="serve_repopulate_error",
+                           error=type(exc).__name__)
+
+    def _notify_invalidation(self, view: MaterializedView) -> None:
+        """Append a versioned ``mview_refresh`` record to the session's
+        fleet invalidation log the moment a refresh COMMITS: every
+        subscribed replica ResultCache drops entries touching the
+        view's source paths, closing the stale-serve window a TTL'd
+        fingerprint probe would otherwise leave open. Only fires when
+        a log already exists (fleet mode attached one) — single-replica
+        serving keeps its zero-overhead path."""
+        log = getattr(self._session, "serve_invalidation_log", None)
+        if log is None:
+            return
+        try:
+            scan = view.inspection.scan
+            paths = getattr(getattr(scan, "source", None), "paths",
+                            None) if scan is not None else None
+            if paths:
+                log.append("mview_refresh", paths)
+        except Exception as exc:  # coherence push is best-effort;
+            # the per-request fingerprint TTL still bounds staleness
+            metrics.record("mview", phase="invalidate_error",
                            error=type(exc).__name__)
 
     def stats(self) -> dict:

@@ -42,6 +42,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from spark_tpu import locks
 from spark_tpu import conf as CF
 from spark_tpu import deadline, faults, metrics, recovery, trace
+from spark_tpu.serve.ownership import (EPOCH_HEADER,
+                                       OwnershipCoordinator)
 
 SERVE_BREAKER_ENABLED = CF.register(
     "spark.tpu.serve.breaker.enabled", True,
@@ -94,7 +96,7 @@ SERVE_BROWNOUT_MIN_EVENTS = CF.register(
 #: response headers a replica sets that the router relays verbatim
 RELAY_HEADERS = ("X-Query-Id", "X-Queue-Wait-Ms", "X-Cache",
                  "Retry-After", "X-SparkTpu-Replica",
-                 "X-SparkTpu-Trace-Id")
+                 "X-SparkTpu-Trace-Id", "X-SparkTpu-Epoch")
 
 #: connection-level failures that mean "this replica is gone" — the
 #: re-dispatch trigger (same set the connect Client classifies as
@@ -231,6 +233,29 @@ class CircuitBreaker:
                 now = time.time()
                 self._window.append((now, True))
                 self._prune(now)
+        self._publish()
+
+    def trip(self) -> None:
+        """OPEN immediately on a connection-level dispatch failure —
+        replica death is not a *rate*, it is a fact. ``failure()``
+        waits for ``minRequests`` outcomes before it will open, which
+        is right for flaky-but-alive replicas and wrong for dead ones:
+        inside the healthProbeSeconds throttle window a dead replica
+        with a closed breaker kept absorbing one doomed forward per
+        dispatch (the probe-vs-dispatch race the PR-14 chaos run
+        caught). The outcome still lands in the window so snapshots
+        account for it."""
+        if not self._enabled():
+            return
+        with self._lock:
+            now = time.time()
+            self._window.append((now, False))
+            self._prune(now)
+            if self.state in ("closed", "half_open"):
+                self._set_state("open")
+                self._opened_at = now
+                self._probe_inflight = False
+                self._window.clear()
         self._publish()
 
     def failure(self) -> None:
@@ -392,6 +417,7 @@ class Federation:
             r.breaker._conf = self._conf
             r.breaker.owner = r.id
         self.brownout = BrownoutController(self._conf)
+        self.ownership = OwnershipCoordinator(self._conf)
 
     # -- health ---------------------------------------------------------------
 
@@ -420,9 +446,67 @@ class Federation:
                 if rid:
                     r.id = str(rid)
                     r.breaker.owner = r.id
+                if r.healthy and self.ownership.enabled():
+                    self._fetch_shards(r)
             except Exception:
                 r.healthy = False
             r.last_probe = time.time()
+        if self.ownership.enabled():
+            self._sync_ownership()
+
+    def _fetch_shards(self, r: Replica) -> None:
+        """Learn the shard map (table -> scan-fingerprint shard) a
+        replica's catalog exposes; best-effort — an older replica
+        without /shards just contributes no shards."""
+        try:
+            with urllib.request.urlopen(r.url + "/shards",
+                                        timeout=2.0) as resp:
+                payload = json.loads(resp.read())
+            self.ownership.register_shards(payload.get("tables", {}))
+        except Exception:
+            pass
+
+    def _sync_ownership(self) -> None:
+        """Re-derive the shard->owner map from current membership; a
+        membership change mints a new epoch which is then broadcast so
+        replicas can fence stale routers and rebuild gained shards."""
+        minted = self.ownership.observe(
+            [r.id for r in self.replicas if r.healthy])
+        if minted is not None:
+            self._broadcast_epoch(minted)
+
+    def _broadcast_epoch(self, payload: dict) -> None:
+        """Push a freshly minted epoch + owner map to every healthy
+        replica. Strictly best-effort and called OUTSIDE all locks: a
+        replica that misses the broadcast (network blip, injected
+        ``serve.ownership`` fault) adopts the epoch lazily from the
+        next stamped request and rebuilds on first touch — bytes never
+        depend on this push landing."""
+        body = json.dumps(payload).encode()
+        with trace.span("serve.epoch", epoch=payload.get("epoch")):
+            for r in self.replicas:
+                if not r.healthy:
+                    continue
+                try:
+                    faults.inject("serve.ownership", self._conf)
+                    req = urllib.request.Request(
+                        r.url + "/epoch", data=body, method="POST",
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=5.0):
+                        pass
+                except Exception as e:
+                    metrics.record(
+                        "fault_recovered", point="serve.ownership",
+                        how="lazy_adopt", replica=r.id,
+                        error=type(e).__name__)
+
+    def _on_replica_death(self, r: Replica) -> None:
+        """A dispatch just proved ``r`` dead: mint a new epoch NOW
+        (not at the next throttled probe) so the dead replica's shards
+        re-map to survivors and their rebuilds start before the next
+        query for those shards arrives."""
+        if self.ownership.enabled():
+            self._sync_ownership()
 
     def healthy(self) -> List[Replica]:
         return [r for r in self.replicas if r.healthy]
@@ -434,13 +518,17 @@ class Federation:
 
     def pick(self, affinity: Optional[str] = None,
              exclude: Sequence[str] = (),
-             least_loaded: bool = False) -> Optional[Replica]:
+             least_loaded: bool = False,
+             prefer: Optional[str] = None) -> Optional[Replica]:
         """Next replica per policy among healthy, non-excluded ones.
-        ``affinity`` (a replica id) wins when that replica is still
-        eligible — consistent session routing keeps a client's
-        scheduler pool state and compile warmth on one backend.
-        ``least_loaded`` forces the load-based choice regardless of
-        policy: the shed path always moves work to the emptiest queue."""
+        ``prefer`` (the shard OWNER under the ownership map) wins over
+        everything when eligible — owner routing is what makes each
+        replica's cache authoritative for its shards. ``affinity``
+        (the ``X-SparkTpu-Replica`` header a client echoes back) wins
+        next — consistent session routing keeps a client's scheduler
+        pool state and compile warmth on one backend. ``least_loaded``
+        forces the load-based choice regardless of policy: the shed
+        path always moves work to the emptiest queue."""
         pool = [r for r in self.healthy() if r.id not in set(exclude)]
         if not pool:
             return None
@@ -451,6 +539,10 @@ class Federation:
         admitted = [r for r in pool if r.breaker.admits()]
         if admitted:
             pool = admitted
+        if prefer:
+            for r in pool:
+                if r.id == prefer:
+                    return r
         if affinity:
             for r in pool:
                 if r.id == affinity:
@@ -527,12 +619,29 @@ class Federation:
         retry_afters: List[float] = []
         last_err: Optional[BaseException] = None
         shed = False
+        # ownership routing: plan the query to the replica OWNING its
+        # scans (rendezvous hash over healthy members) so the fleet
+        # behaves as one coherent cache instead of N cold ones
+        shards: Tuple[str, ...] = ()
+        if self.ownership.enabled() and path in ("/sql", "/plan") \
+                and body:
+            try:
+                q = json.loads(body).get("query", "")
+                shards = self.ownership.shards_for_sql(q)
+            except Exception:
+                shards = ()
         for attempt in range(retries + len(self.replicas) + 1):
             deadline.check("serve.dispatch")
             self.probe()
+            # owner is re-derived per attempt: a failover two lines
+            # down re-maps the shard, and the retry must follow it
+            prefer = self.ownership.owner_for(shards) if shards \
+                else None
             r = self.pick(affinity=affinity,
                           exclude=exhausted | dead,
-                          least_loaded=shed)
+                          least_loaded=shed,
+                          prefer=prefer if prefer not in
+                          (exhausted | dead) else None)
             affinity = None  # only honored for the first choice
             if r is None:
                 break
@@ -551,14 +660,23 @@ class Federation:
                     hv = trace.header_value()
                     if hv:
                         hdrs[trace.TRACE_HEADER] = hv
+                    if self.ownership.enabled():
+                        # per-ATTEMPT stamp: a failover between
+                        # attempts must fence the retry at the new
+                        # epoch, not the one the request started with
+                        hdrs[EPOCH_HEADER] = str(self.ownership.epoch)
                     code, data, hdr = self.forward(
                         r, method, path, body, hdrs)
             except _CONN_ERRORS as e:
                 last_err = e
-                r.breaker.failure()
+                # a connection-level failure is a fact, not a rate:
+                # trip the breaker open IMMEDIATELY, even inside the
+                # healthProbeSeconds throttle window
+                r.breaker.trip()
                 self.brownout.note("failure")
                 r.healthy = False
                 dead.add(r.id)
+                self._on_replica_death(r)
                 if len(dead) > retries:
                     break
                 metrics.note_serve("replica_failures")
@@ -576,10 +694,11 @@ class Federation:
                     raise  # corrupt/oom: surface typed, no retry
                 # injected replica death mid-query: same recovery as a
                 # real connection failure
-                r.breaker.failure()
+                r.breaker.trip()
                 self.brownout.note("failure")
                 r.healthy = False
                 dead.add(r.id)
+                self._on_replica_death(r)
                 if len(dead) > retries:
                     break
                 metrics.note_serve("replica_failures")
@@ -591,6 +710,28 @@ class Federation:
                 metrics.record("serve", phase="redispatch",
                                replica=r.id)
                 continue
+            if code == 409 and self.ownership.enabled():
+                # typed EPOCH_RETRY: the replica fenced a stale stamp
+                # (it learned of a newer epoch than this router holds,
+                # e.g. from a concurrent router). The replica ANSWERED
+                # — its breaker records the success — and the request
+                # re-dispatches with a fresh stamp under the unified
+                # retry budget.
+                r.breaker.success()
+                new_epoch = 0
+                try:
+                    detail = json.loads(data)
+                    new_epoch = int(hdr.get(EPOCH_HEADER)
+                                    or detail.get("epoch") or 0)
+                except Exception:
+                    pass
+                self.ownership.bump_to(new_epoch)
+                metrics.note_serve("epoch_retries")
+                metrics.record("serve", phase="epoch_retry",
+                               replica=r.id, epoch=new_epoch)
+                if recovery.retry_allowed("serve.dispatch"):
+                    continue
+                return code, data, hdr  # budget spent: surface typed
             if code == 429:
                 # admission shedding: this replica's scheduler is
                 # full — take the request to the emptiest other queue.

@@ -35,8 +35,18 @@ import pyarrow as pa
 
 from spark_tpu import locks
 from spark_tpu import conf as CF
-from spark_tpu import deadline, metrics
+from spark_tpu import deadline, faults, metrics, trace
 from spark_tpu.storage.lru import LruDict
+
+SERVE_FP_CACHE_SECONDS = CF.register(
+    "spark.tpu.serve.fingerprintCacheSeconds", 0.0,
+    "TTL on a replica's per-source freshness-fingerprint probe (the "
+    "stat walk behind the result-cache key). 0 (default) stats on "
+    "every request — always fresh, but a stat storm under fleet "
+    "traffic. > 0 amortizes the probe and OPENS a stale-serve window "
+    "exactly as wide as the TTL; the fleet invalidation log closes it "
+    "by dropping entries (and the cached probe) the moment a refresh "
+    "or rewrite commits.", float)
 
 #: follower wait bound per round: the owner always sets the flight
 #: event in a ``finally``, so this only guards against an owner thread
@@ -128,6 +138,14 @@ class ResultCache:
             conf=conf)
         self._flights: dict = {}
         self._lock = locks.named_lock("serve.result_cache")
+        #: TTL'd per-source fingerprint probes: {paths tuple ->
+        #: (fingerprint, stamp)} — populated only when
+        #: spark.tpu.serve.fingerprintCacheSeconds > 0
+        self._fp_cache: dict = {}
+        #: last invalidation-log version applied (watermark replay on
+        #: reattach)
+        self.invalidation_watermark = 0
+        self._inval_log = None
 
     def enabled(self) -> bool:
         try:
@@ -252,7 +270,145 @@ class ResultCache:
 
     def clear(self) -> None:
         self._lru.clear()
+        with self._lock:
+            self._fp_cache.clear()
         self._publish_gauges()
+
+    # -- fingerprint probe cache ----------------------------------------------
+
+    def _fp_ttl(self) -> float:
+        try:
+            return float(self._conf.get(SERVE_FP_CACHE_SECONDS))
+        except Exception:
+            return float(SERVE_FP_CACHE_SECONDS.default)
+
+    def result_key(self, plan) -> Tuple[Any, ...]:
+        """Cache key for ``plan`` through THIS cache's fingerprint
+        probe: with fingerprintCacheSeconds <= 0 this is exactly
+        ``plan_result_key`` (a fresh stat walk per request); with a
+        TTL, per-source probes are reused until they expire or an
+        invalidation-log record drops them."""
+        ttl = self._fp_ttl()
+        if ttl <= 0.0:
+            return plan_result_key(plan)
+        from spark_tpu.io.fingerprint import source_fingerprint
+        from spark_tpu.plan import logical as L
+
+        now = time.time()
+        fps = []
+        for scan in L.collect_nodes(plan, L.UnresolvedScan):
+            src = scan.source
+            paths = getattr(src, "paths", None)
+            if not paths:
+                fp = source_fingerprint(src)
+                fps.append(fp if fp is not None
+                           else ("src", id(src)))
+                continue
+            pkey = tuple(str(p) for p in paths)
+            with self._lock:
+                hit = self._fp_cache.get(pkey)
+            if hit is not None and now - hit[1] < ttl:
+                fps.append(hit[0])
+                continue
+            fp = source_fingerprint(src)
+            if fp is None:
+                fps.append(("src", id(src)))
+                continue
+            with self._lock:
+                self._fp_cache[pkey] = (fp, now)
+            fps.append(fp)
+        return (plan.structural_key(), tuple(fps))
+
+    # -- fleet-wide invalidation ----------------------------------------------
+
+    def attach_invalidation_log(self, log) -> "ResultCache":
+        """Subscribe to a fleet InvalidationLog, first replaying every
+        record after this cache's watermark (a reconnecting/revived
+        replica catches up); a watermark older than the log's bounded
+        ring forces the planned worst case — a full clear (cold,
+        never stale)."""
+        records, resync = log.since(self.invalidation_watermark)
+        if resync:
+            self.clear()
+            self.invalidation_watermark = log.version
+            metrics.record("serve", phase="invalidate_resync",
+                           watermark=self.invalidation_watermark)
+        else:
+            for record in records:
+                self.apply_invalidation(record)
+        log.subscribe(self.apply_invalidation)
+        self._inval_log = log
+        return self
+
+    def detach_invalidation_log(self) -> None:
+        if self._inval_log is not None:
+            self._inval_log.unsubscribe(self.apply_invalidation)
+            self._inval_log = None
+
+    def apply_invalidation(self, record: dict) -> None:
+        """Drop every cached result (and fingerprint probe) whose key
+        touches the record's paths. Any failure — including an
+        injected ``serve.invalidate`` fault — degrades to a FULL
+        clear: after an invalidation the one state this cache may not
+        hold is a stale entry, and empty is always sound."""
+        with trace.span("serve.invalidate",
+                        version=record.get("v", 0)):
+            try:
+                faults.inject("serve.invalidate", self._conf)
+                dropped = self._drop_paths(record.get("paths", ()))
+                metrics.record("serve", phase="invalidate_apply",
+                               version=record.get("v", 0),
+                               dropped=dropped)
+            except Exception as exc:
+                self.clear()
+                metrics.record(
+                    "fault_recovered", point="serve.invalidate",
+                    how="full_clear", error=type(exc).__name__)
+            self.invalidation_watermark = max(
+                self.invalidation_watermark, int(record.get("v", 0)))
+        self._publish_gauges()
+
+    @staticmethod
+    def _touches(path: str, targets) -> bool:
+        """Does file ``path`` equal, live under, or contain one of the
+        invalidated ``targets``? (Fingerprints hold walked FILE paths;
+        invalidation records may carry the source DIRECTORY.)"""
+        import os as _os
+
+        for t in targets:
+            if path == t or path.startswith(t.rstrip(_os.sep)
+                                            + _os.sep) \
+                    or t.startswith(path.rstrip(_os.sep) + _os.sep):
+                return True
+        return False
+
+    def _drop_paths(self, paths) -> int:
+        targets = tuple(str(p) for p in paths)
+        if not targets:
+            return 0
+        dropped = 0
+        for key in self._lru.keys():
+            fps = key[1] if isinstance(key, tuple) and len(key) == 2 \
+                else ()
+            hit = False
+            for fp in fps if isinstance(fps, tuple) else ():
+                if not isinstance(fp, tuple):
+                    continue
+                for triple in fp:
+                    if isinstance(triple, tuple) and triple \
+                            and isinstance(triple[0], str) \
+                            and self._touches(triple[0], targets):
+                        hit = True
+                        break
+                if hit:
+                    break
+            if hit and self._lru.pop(key) is not None:
+                dropped += 1
+        with self._lock:
+            for pkey in list(self._fp_cache):
+                if any(self._touches(str(p), targets) for p in pkey):
+                    del self._fp_cache[pkey]
+        return dropped
 
     def stats(self) -> dict:
         counters = metrics.serve_stats()

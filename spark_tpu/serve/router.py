@@ -132,6 +132,8 @@ class FederationRouter:
                         "replicas": reps,
                         "brownout":
                             outer.federation.brownout.snapshot(),
+                        "ownership":
+                            outer.federation.ownership.snapshot(),
                         "retry_budget":
                             metrics.retry_budget_stats()}).encode()
                     self._send(200, body, "application/json")
@@ -222,13 +224,33 @@ def serve_fleet(session, replicas: Optional[int] = None,
     (default ``spark.tpu.serve.replicas``) and a FederationRouter in
     front; returns the started Fleet."""
     from spark_tpu.connect.server import ConnectServer
+    from spark_tpu.serve.ownership import (SERVE_OWNERSHIP_ENABLED,
+                                           session_invalidation_log)
+    from spark_tpu.serve.result_cache import ResultCache
 
     n = int(replicas if replicas is not None
             else session.conf.get(CF.SERVE_REPLICAS))
     n = max(1, n)
+    try:
+        owned = bool(session.conf.get(SERVE_OWNERSHIP_ENABLED))
+    except Exception:
+        owned = False
+    caches = None
+    if owned:
+        # ownership mode: each replica keys and owns its OWN result
+        # cache (the fleet-coherence contract is the invalidation log
+        # + owner routing, not shared memory) — this is the in-process
+        # stand-in for the multi-process fleet, where separate caches
+        # are physically forced
+        log = session_invalidation_log(session)
+        caches = [
+            ResultCache(session.conf).attach_invalidation_log(log)
+            for _ in range(n)]
     servers = [
         ConnectServer(session, host=host, port=0,
-                      replica_id=f"r{i}").start()
+                      replica_id=f"r{i}",
+                      result_cache=caches[i] if caches else None
+                      ).start()
         for i in range(n)]
     router = FederationRouter(servers, conf=session.conf,
                               host=host, port=port,

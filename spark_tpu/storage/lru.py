@@ -154,3 +154,10 @@ class LruDict:
         with self._lock:
             self._bytes -= self._weights.pop(key, 0)
             return self._d.pop(key, default)
+
+    def keys(self) -> list:
+        """Point-in-time key snapshot (LRU order, oldest first) —
+        the serve-tier invalidation sweep iterates this and pops
+        matches without holding the lock across the scan."""
+        with self._lock:
+            return list(self._d.keys())

@@ -79,6 +79,8 @@ SPAN_NAMES = frozenset({
     "pipeline.transfer",    # chunk pipeline: one chunk host->device
     "fault.retry",          # one recovery re-attempt after a fault
     "result_cache.probe",   # serve-tier plan-keyed result cache probe
+    "serve.epoch",          # ownership epoch mint + fleet broadcast
+    "serve.invalidate",     # one invalidation-log record applied
     "mview.probe",          # materialized-view / cache-manager probe
     "storage.pin",          # HBM pin-scope around query execution
     "join.partition",       # hybrid hash join: grant + partition pass

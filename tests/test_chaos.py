@@ -83,6 +83,47 @@ def test_chaos_replay_artifact_roundtrip(tmp_path):
     assert chaos.replay_artifact(str(art)) == sch
 
 
+def test_chaos_kill_and_revive_schedule(spark, fleet):
+    """The campaign's kill-and-revive arc under tier-1: a replica dies
+    (the DISPATCH finds the corpse inside the probe throttle and trips
+    the breaker immediately), the fleet serves byte-identical results
+    through the death, and the revived replica rejoins on its original
+    port and serves again."""
+    import time
+
+    from spark_tpu.connect.server import ConnectServer
+
+    clean = _workload(spark, fleet.url)
+    fed = fleet.router.federation
+    spark.conf.set("spark.tpu.serve.healthProbeSeconds", "3600.0")
+    spark.conf.set("spark.tpu.serve.breaker.openSeconds", "0.3")
+    try:
+        fed.probe(force=True)
+        for r in fed.replicas:
+            r.breaker.reset()
+            r.last_probe = time.time()  # probes throttled from here
+        victim = fleet.replicas[0]
+        host, port, rid = victim.host, victim.port, victim.replica_id
+        victim.stop()
+        during = _workload(spark, fleet.url)
+        assert during == clean, "bytes changed during replica death"
+        dead = next(r for r in fed.replicas if r.id == rid)
+        assert dead.breaker.state == "open"  # one dispatch tripped it
+        revived = ConnectServer(spark, host=host, port=port,
+                                replica_id=rid).start()
+        try:
+            time.sleep(0.35)            # past breaker.openSeconds
+            fed.probe(force=True)
+            after = _workload(spark, fleet.url)
+            assert after == clean, "bytes changed after revive"
+            assert dead.healthy
+        finally:
+            revived.stop()
+    finally:
+        spark.conf.unset("spark.tpu.serve.healthProbeSeconds")
+        spark.conf.unset("spark.tpu.serve.breaker.openSeconds")
+
+
 def test_router_health_reports_resilience(spark, fleet):
     with urllib.request.urlopen(fleet.url + "/health",
                                 timeout=10.0) as resp:

@@ -1,0 +1,436 @@
+"""Fleet data plane (spark_tpu/serve/ownership.py): replica-owned
+shards with epoch-fenced ownership failover and coherent fleet-wide
+caches.
+
+Covers the ownership map (rendezvous hashing: deterministic, minimal
+movement on member death), shard keys (path-set only — an append must
+NOT move ownership), epoch fencing (stale dispatch -> typed 409
+EPOCH_RETRY absorbed by the retry budget), owner routing + byte-
+identical failover, the versioned invalidation log (append / replay /
+resync / subscriber push), the probe-vs-dispatch breaker race fix
+(a dispatch failure trips the breaker immediately, even inside the
+healthProbeSeconds throttle window), Client.last_query fleet metadata,
+and the seeded concurrent append+read interleaving: once a refresh
+commits, a replica that never touched the source never again returns
+pre-append bytes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_tpu import chaos, conf as CF, faults, locks, metrics, trace
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.connect.server import Client
+from spark_tpu.serve.federation import Federation
+from spark_tpu.serve.ownership import (EPOCH_HEADER, EpochRetry,
+                                       InvalidationLog,
+                                       OwnershipCoordinator,
+                                       rendezvous_owner, shard_key,
+                                       session_invalidation_log)
+from spark_tpu.serve.result_cache import ResultCache
+from spark_tpu.serve.router import serve_fleet
+
+pytestmark = [pytest.mark.serve, pytest.mark.timeout(240)]
+
+_FLEET_CONF = (
+    "spark.tpu.serve.ownership.enabled",
+    "spark.tpu.serve.resultCache.enabled",
+    "spark.tpu.serve.fingerprintCacheSeconds",
+    "spark.tpu.serve.healthProbeSeconds",
+    "spark.tpu.mview.enabled",
+)
+
+
+@pytest.fixture
+def fleet3(spark, tmp_path):
+    """Three-replica ownership fleet over one parquet table
+    ``fleet_t``; cleans every fleet conf override on exit."""
+    path = str(tmp_path / "fleet_t.parquet")
+    pq.write_table(pa.table({
+        "a": list(range(64)),
+        "b": [float(i) * 0.5 for i in range(64)]}), path)
+    spark.read.parquet(path).createOrReplaceTempView("fleet_t")
+    spark.conf.set("spark.tpu.serve.ownership.enabled", "true")
+    spark.conf.set("spark.tpu.serve.resultCache.enabled", "true")
+    fl = serve_fleet(spark, replicas=3)
+    try:
+        yield fl, path
+    finally:
+        fl.stop()
+        for k in _FLEET_CONF:
+            if k in spark.conf._overrides:
+                spark.conf.unset(k)
+        faults.reset(spark.conf)
+        log = getattr(spark, "serve_invalidation_log", None)
+        if log is not None:
+            for s in fl.replicas:
+                if s.result_cache is not None:
+                    s.result_cache.detach_invalidation_log()
+        rc = getattr(spark, "serve_result_cache", None)
+        if rc is not None:
+            rc.clear()
+
+
+# ---- ownership map: rendezvous hashing + shard keys -------------------------
+
+
+def test_rendezvous_owner_deterministic_minimal_movement():
+    members = ["r0", "r1", "r2", "r3"]
+    shards = [f"shard-{i:03d}" for i in range(200)]
+    before = {s: rendezvous_owner(s, members) for s in shards}
+    # memoryless: owner depends on (shard, member set), not call order
+    assert before == {
+        s: rendezvous_owner(s, list(reversed(members))) for s in shards}
+    # every member owns something at this shard count
+    assert set(before.values()) == set(members)
+    # kill r1: ONLY r1's shards move (the HRW minimal-movement
+    # property the failover story depends on)
+    survivors = [m for m in members if m != "r1"]
+    after = {s: rendezvous_owner(s, survivors) for s in shards}
+    moved = {s for s in shards if before[s] != after[s]}
+    assert moved == {s for s in shards if before[s] == "r1"}
+    assert all(after[s] in survivors for s in shards)
+
+
+def test_shard_key_is_path_set_only(tmp_path):
+    p1, p2 = str(tmp_path / "x.parquet"), str(tmp_path / "y.parquet")
+    k = shard_key([p1, p2])
+    assert k == shard_key([p2, p1])          # order-free
+    assert k == shard_key([p1, p2, p1])      # duplicate-free
+    assert k != shard_key([p1])
+    # mtime-free by construction: an append (same path set) must not
+    # move ownership, only invalidate caches
+    pq.write_table(pa.table({"a": [1]}), p1)
+    k2 = shard_key([p1, p2])
+    pq.write_table(pa.table({"a": [1, 2]}), p1)
+    assert shard_key([p1, p2]) == k2 == k
+
+
+def test_ownership_coordinator_epoch_lifecycle():
+    conf = RuntimeConf({"spark.tpu.serve.ownership.enabled": True})
+    own = OwnershipCoordinator(conf)
+    assert own.enabled()
+    sk = shard_key(["/data/t.parquet"])
+    own.register_shards({
+        "t": {"shard": sk, "paths": ["/data/t.parquet"]},
+        "u": {"shard": shard_key(["/data/u.parquet"]),
+              "paths": ["/data/u.parquet"]}})
+    minted = own.observe(["r0", "r1", "r2"])
+    assert minted is not None and minted["epoch"] == own.epoch == 1
+    # stable membership: no re-mint
+    assert own.observe(["r2", "r1", "r0"]) is None
+    # member death mints the next epoch
+    minted2 = own.observe(["r0", "r2"])
+    assert minted2 is not None and own.epoch == 2
+    assert all(o in ("r0", "r2") for o in minted2["owners"].values())
+    # table extraction routes a query to its shard's owner
+    shards = own.shards_for_sql("SELECT a FROM t JOIN u ON t.a = u.a")
+    assert sk in shards
+    assert own.owner_for([sk]) == rendezvous_owner(sk, ["r0", "r2"])
+    # epochs are monotonic — bump_to never regresses
+    own.bump_to(7)
+    own.bump_to(3)
+    assert own.epoch == 7
+
+
+def test_epoch_retry_is_typed():
+    err = EpochRetry(2, 5)
+    assert "EPOCH_RETRY" in str(err)
+    assert err.request_epoch == 2 and err.fleet_epoch == 5
+    assert chaos.is_typed_error(err)
+
+
+# ---- versioned invalidation log ---------------------------------------------
+
+
+def test_invalidation_log_append_since_resync():
+    log = InvalidationLog(RuntimeConf(
+        {"spark.tpu.serve.invalidationLog.maxRecords": 4}))
+    seen, bad_calls = [], []
+
+    def bad(_record):
+        bad_calls.append(1)
+        raise RuntimeError("broken subscriber")
+
+    log.subscribe(bad)          # must not lose records for `seen`
+    log.subscribe(seen.append)
+    for i in range(3):
+        log.append("mview_refresh", [f"/d/f{i}.parquet"])
+    assert log.version == 3 and len(seen) == 3 and len(bad_calls) == 3
+    assert seen[-1]["v"] == 3 and seen[-1]["kind"] == "mview_refresh"
+    records, resync = log.since(1)
+    assert not resync and [r["v"] for r in records] == [2, 3]
+    # overflow the 4-record ring: old watermarks now need a resync
+    for i in range(6):
+        log.append("source_changed", [f"/d/g{i}.parquet"])
+    _, resync = log.since(1)
+    assert resync
+    records, resync = log.since(log.version - 1)
+    assert not resync and len(records) == 1
+    log.unsubscribe(seen.append)
+    log.append("source_changed", ["/d/zz.parquet"])
+    assert seen[-1]["v"] != log.version
+
+
+def test_invalidation_drops_results_and_fp_probes(spark, tmp_path):
+    """The coherence core: an invalidation record drops both the
+    cached result bytes AND the TTL'd fingerprint probe that would
+    re-key the stale entry back to life."""
+    path = str(tmp_path / "inv_t.parquet")
+    pq.write_table(pa.table({"a": [1, 2, 3]}), path)
+    spark.read.parquet(path).createOrReplaceTempView("inv_t")
+    spark.conf.set("spark.tpu.serve.fingerprintCacheSeconds", "300.0")
+    log = InvalidationLog(spark.conf)
+    cache = ResultCache(spark.conf).attach_invalidation_log(log)
+    try:
+        df = spark.sql("SELECT a FROM inv_t WHERE a >= 2")
+        key = cache.result_key(df._plan)
+        cache.put(key, b"stale-bytes")
+        assert cache.lookup(key) == b"stale-bytes"
+        assert len(cache._fp_cache) == 1
+        v = log.append("source_changed", [path])
+        assert cache.invalidation_watermark == v == 1
+        assert cache.lookup(key) is None
+        assert len(cache._fp_cache) == 0
+        # unrelated paths leave the cache alone
+        cache.put(key, b"again")
+        log.append("source_changed", [str(tmp_path / "other.parquet")])
+        assert cache.lookup(key) == b"again"
+    finally:
+        cache.detach_invalidation_log()
+        spark.conf.unset("spark.tpu.serve.fingerprintCacheSeconds")
+
+
+def test_invalidation_fault_degrades_to_full_clear(spark, tmp_path):
+    """An injected serve.invalidate fault may not leave a stale entry:
+    the apply path degrades to a FULL clear (empty is always sound)."""
+    log = InvalidationLog(spark.conf)
+    cache = ResultCache(spark.conf).attach_invalidation_log(log)
+    try:
+        cache.put(("k", ()), b"v")
+        spark.conf.set(
+            "spark.tpu.faultInjection.serve.invalidate", "nth:1")
+        faults.reset(spark.conf)
+        v = log.append("source_changed", ["/nowhere/at/all.parquet"])
+        assert len(cache._lru) == 0          # cleared, not stale
+        assert cache.invalidation_watermark == v
+    finally:
+        cache.detach_invalidation_log()
+        spark.conf.unset("spark.tpu.faultInjection.serve.invalidate")
+        faults.reset(spark.conf)
+
+
+# ---- epoch fencing + owner routing + failover -------------------------------
+
+
+def _probe(fl):
+    fl.router.federation.probe(force=True)
+
+
+def test_epoch_fence_stale_dispatch_409(spark, fleet3):
+    fl, _ = fleet3
+    _probe(fl)  # discover shards, mint epoch 1, broadcast to replicas
+    fed = fl.router.federation
+    assert fed.ownership.epoch >= 1
+    target = next(s for s in fl.replicas
+                  if s.fleet_epoch == fed.ownership.epoch)
+    req = urllib.request.Request(
+        target.url + "/sql",
+        data=json.dumps({"query": "SELECT a FROM fleet_t"}).encode(),
+        headers={"Content-Type": "application/json", EPOCH_HEADER: "0"},
+        method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10.0)
+    assert ei.value.code == 409
+    detail = json.loads(ei.value.read())
+    assert detail["error"] == "EpochRetry"
+    assert "EPOCH_RETRY" in detail["message"]
+    assert detail["epoch"] == target.fleet_epoch
+    # the fence is observable in metrics
+    assert metrics.serve_stats().get("epoch_fences", 0) >= 1
+
+
+def test_owner_failover_byte_identical(spark, fleet3):
+    fl, _ = fleet3
+    _probe(fl)
+    c1 = Client(fl.url, timeout=20.0, retries=3)
+    t1 = c1.sql("SELECT a, b FROM fleet_t WHERE a >= 8")
+    owner = c1.last_query["replica"]
+    epoch0 = fl.router.federation.ownership.epoch
+    assert epoch0 >= 1
+    # owner routing is sticky: the same plan lands on the same owner
+    c2 = Client(fl.url, timeout=20.0, retries=3)
+    t2 = c2.sql("SELECT a, b FROM fleet_t WHERE a >= 8")
+    assert c2.last_query["replica"] == owner
+    assert t2.equals(t1)
+    # kill the owner mid-fleet: the next dispatch fails over to a
+    # survivor, byte-identical, under a freshly minted epoch
+    victim = next(s for s in fl.replicas if s.replica_id == owner)
+    victim.stop()
+    c3 = Client(fl.url, timeout=30.0, retries=3)
+    t3 = c3.sql("SELECT a, b FROM fleet_t WHERE a >= 8")
+    assert t3.equals(t1), "failover changed result bytes"
+    assert c3.last_query["replica"] != owner
+    assert fl.router.federation.ownership.epoch > epoch0
+
+
+def test_client_last_query_surfaces_fleet_metadata(spark, fleet3):
+    fl, _ = fleet3
+    _probe(fl)
+    c = Client(fl.url, timeout=20.0, retries=3)
+    c.sql("SELECT a FROM fleet_t WHERE a < 4")
+    lq = c.last_query
+    assert lq["replica"] in {s.replica_id for s in fl.replicas}
+    assert lq["cache"] in ("hit", "miss")
+    assert isinstance(lq["epoch"], int) and lq["epoch"] >= 1
+    # same plan again: a hit, same owner, same epoch
+    c.sql("SELECT a FROM fleet_t WHERE a < 4")
+    assert c.last_query["cache"] == "hit"
+    assert c.last_query["replica"] == lq["replica"]
+
+
+# ---- the probe-vs-dispatch breaker race (PR 14 chaos regression) ------------
+
+
+def test_dispatch_failure_trips_breaker_inside_probe_throttle():
+    """Regression: a replica death seen by a DISPATCH must open the
+    breaker immediately, even when the probe loop is throttled by
+    healthProbeSeconds and the windowed failure-rate gate (minRequests)
+    has not seen enough traffic. Death is a fact, not a rate."""
+    from spark_tpu.serve.federation import NoHealthyReplica
+
+    fed = Federation(
+        [("dead", "http://127.0.0.1:9")],
+        conf=RuntimeConf({"spark.tpu.serve.healthProbeSeconds": 3600.0}))
+    dead = fed.replicas[0]
+    dead.healthy = True
+    dead.last_probe = time.time()  # probe ran "just now": throttled
+    assert dead.breaker.state == "closed"
+    with pytest.raises(NoHealthyReplica):
+        fed.dispatch(
+            "POST", "/sql", json.dumps({"query": "SELECT 1"}).encode(),
+            headers={"Content-Type": "application/json"})
+    # ONE failed dispatch — far below the windowed minRequests gate —
+    # and the breaker is already open
+    assert dead.breaker.state == "open"
+
+
+def test_breaker_trip_is_immediate_and_idempotent():
+    fed = Federation([("x", "http://127.0.0.1:9")], conf=RuntimeConf())
+    br = fed.replicas[0].breaker
+    assert br.state == "closed"
+    br.trip()
+    assert br.state == "open"
+    br.trip()  # idempotent
+    assert br.state == "open"
+
+
+# ---- satellite: seeded concurrent append+read interleaving ------------------
+
+
+def test_concurrent_append_read_no_stale_after_refresh(
+        spark, tmp_path, rng):
+    """Seeded interleaving: readers hammer a SECOND replica (one that
+    never appends) while the source grows and a cached-mview refresh
+    commits on the first session. Reads racing the append may see old
+    OR new bytes — but once the refresh commit's invalidation
+    broadcast lands, no read may return pre-append bytes again."""
+    path = str(tmp_path / "ivt.parquet")
+    pq.write_table(pa.table({
+        "a": list(range(32)),
+        "b": [float(i) for i in range(32)]}), path)
+    spark.read.parquet(path).createOrReplaceTempView("ivt")
+    spark.conf.set("spark.tpu.serve.ownership.enabled", "true")
+    spark.conf.set("spark.tpu.serve.resultCache.enabled", "true")
+    spark.conf.set("spark.tpu.serve.fingerprintCacheSeconds", "300.0")
+    spark.conf.set("spark.tpu.mview.enabled", "true")
+    # an AGGREGATE plan: only those register as materialized views,
+    # and only the mview refresh closes the plain-cache staleness hole
+    q = "SELECT a % 4 AS g, SUM(b) AS s FROM ivt GROUP BY a % 4"
+    cached = spark.sql(q)
+    cached.cache()  # registers the mview whose refresh broadcasts
+    cached.collect()
+    assert len(spark.mview_manager.views()) == 1
+    fl = serve_fleet(spark, replicas=2)
+    try:
+        fl.router.federation.probe(force=True)
+        clients = {s.replica_id: Client(s.url, timeout=20.0, retries=3)
+                   for s in fl.replicas}
+        # warm BOTH replica caches directly (bypassing owner routing)
+        pre = {rid: c.sql(q).to_pydict()
+               for rid, c in clients.items()}
+        assert len({json.dumps(p, sort_keys=True)
+                    for p in pre.values()}) == 1
+        reads, stop = [], threading.Event()
+        second = sorted(clients)[1]
+
+        def reader():
+            c = clients[second]
+            while not stop.is_set():
+                t0 = time.time()
+                reads.append((t0, c.sql(q).to_pydict()))
+                time.sleep(float(rng.uniform(0.001, 0.01)))
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        time.sleep(float(rng.uniform(0.01, 0.05)))  # seeded overlap
+        pq.write_table(pa.table({
+            "a": list(range(32)) + [100 + i for i in range(8)],
+            "b": [float(i) for i in range(40)]}), path)
+        # the refresh commits HERE: the local collect detects the
+        # rewrite, refreshes the cached view, and broadcasts
+        fresh = spark.sql(q).collect()
+        commit_t = time.time()
+        assert spark.mview_manager.views()[0]["refreshes"] >= 1
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if any(t0 > commit_t for t0, _ in reads):
+                break
+            time.sleep(0.01)
+        stop.set()
+        th.join(timeout=20.0)
+        post = [r for t0, r in reads if t0 > commit_t]
+        assert post, "no read landed after the refresh commit"
+        stale = [r for r in post if r == pre[second]]
+        assert not stale, (
+            f"{len(stale)}/{len(post)} post-commit reads returned "
+            "pre-append bytes")
+        # the fresh replica bytes agree with the local refresh result
+        want = {row["g"]: row["s"] for row in fresh}
+        got = dict(zip(post[-1]["g"], post[-1]["s"]))
+        assert got == want
+        cached.unpersist()
+    finally:
+        stop.set()
+        fl.stop()
+        for k in _FLEET_CONF:
+            if k in spark.conf._overrides:
+                spark.conf.unset(k)
+
+
+# ---- registry wiring --------------------------------------------------------
+
+
+def test_fleet_registrations():
+    for key in ("spark.tpu.serve.ownership.enabled",
+                "spark.tpu.serve.ownership.rebuildOnFailover",
+                "spark.tpu.serve.ownership.rebuildTimeoutSeconds",
+                "spark.tpu.serve.invalidationLog.maxRecords",
+                "spark.tpu.serve.fingerprintCacheSeconds"):
+        assert CF.is_registered(key), key
+    assert "serve.ownership" in faults.POINTS
+    assert "serve.invalidate" in faults.POINTS
+    assert "serve.epoch" in trace.SPAN_NAMES
+    assert "serve.invalidate" in trace.SPAN_NAMES
+    assert locks.LOCK_RANKS["serve.ownership"] > \
+        locks.LOCK_RANKS["serve.invalidation"]
+    for m in ("epoch_mints", "epoch_retries", "epoch_fences",
+              "invalidations", "rebuilds"):
+        assert m in metrics.serve_stats(), m

@@ -68,6 +68,8 @@ def _make_session(tmp):
     pq.write_table(u, pu)
     sess.read.parquet(pt).createOrReplaceTempView("t")
     sess.read.parquet(pu).createOrReplaceTempView("u")
+    #: backing files, for the fleet family's append scenario
+    sess._chaos_tables = {"t": pt, "u": pu}
     return sess
 
 
@@ -75,13 +77,24 @@ def _result_bytes(table: pa.Table) -> bytes:
     return json.dumps(table.to_pydict(), sort_keys=True).encode()
 
 
-def _workload(session, url: str, timeout: float):
+def _clear_caches(session, fleet=None) -> None:
+    """Faults must reach the engine, not a cached blob — drop the
+    shared session cache AND (ownership mode) every replica-local
+    one."""
+    rc = getattr(session, "serve_result_cache", None)
+    if rc is not None:
+        rc.clear()
+    for s in (fleet.replicas if fleet is not None else ()):
+        c = getattr(s, "result_cache", None)
+        if c is not None:
+            c.clear()
+
+
+def _workload(session, url: str, timeout: float, fleet=None):
     """One campaign iteration: all queries through a FRESH client (no
     carried affinity) against the fleet; returns concatenated
     deterministic bytes."""
-    rc = getattr(session, "serve_result_cache", None)
-    if rc is not None:
-        rc.clear()  # faults must reach the engine, not a cached blob
+    _clear_caches(session, fleet)
     client = Client(url, timeout=timeout, retries=3)
     out = []
     for q in _QUERIES:
@@ -91,14 +104,15 @@ def _workload(session, url: str, timeout: float):
 
 def _campaign(session, fleet, args) -> bool:
     conf = session.conf
-    clean = _workload(session, fleet.url, args.timeout)
+    clean = _workload(session, fleet.url, args.timeout, fleet)
     # serve-tier points need the fleet; engine points fire inside the
     # replicas — arm everything
     schedules = chaos.generate_campaign(args.seed, args.schedules)
     print(f"chaos campaign: seed={args.seed} "
-          f"schedules={args.schedules}")
+          f"schedules={args.schedules} family={args.family}")
     report = chaos.run_campaign(
-        conf, lambda: _workload(session, fleet.url, args.timeout),
+        conf,
+        lambda: _workload(session, fleet.url, args.timeout, fleet),
         schedules, clean_bytes=clean, alarm_s=args.alarm,
         queries=len(_QUERIES),
         memory_manager=session.memory_manager,
@@ -127,12 +141,13 @@ def _kill_revive(session, fleet, args) -> bool:
     half_open -> closed."""
     conf = session.conf
     fed = fleet.router.federation
-    conf.set("spark.tpu.serve.breaker.minRequests", 1)
     conf.set("spark.tpu.serve.breaker.openSeconds", 0.3)
-    # throttle background health probes: otherwise the router's /health
-    # check notices the death first and sidelines the replica before a
-    # dispatch ever fails against it, so the breaker never trips. The
-    # scenario drives probes explicitly with probe(force=True).
+    # throttle background health probes so the DISPATCH is what finds
+    # the corpse (the router's /health check would otherwise sideline
+    # the replica first and no forward would ever fail against it);
+    # breaker.trip() opens on that single connection failure — no
+    # minRequests warm-up needed. Probes are driven explicitly with
+    # probe(force=True).
     conf.set("spark.tpu.serve.healthProbeSeconds", 3600.0)
     try:
         # the random sweep may have left stale unhealthy flags and a
@@ -153,7 +168,8 @@ def _kill_revive(session, fleet, args) -> bool:
               f"({host}:{port})")
         victim.stop()
         # the affinity-routed request hits the dead replica, fails,
-        # re-dispatches, and the breaker opens (minRequests=1)
+        # re-dispatches, and trip() opens the breaker on that single
+        # connection failure
         _result_bytes(client.sql(_QUERIES[1]))
         state = rep.breaker.state
         print(f"  after dispatch failure: breaker={state}")
@@ -182,7 +198,6 @@ def _kill_revive(session, fleet, args) -> bool:
               f"(final={rep.breaker.state})")
         return ok
     finally:
-        conf.unset("spark.tpu.serve.breaker.minRequests")
         conf.unset("spark.tpu.serve.breaker.openSeconds")
         conf.unset("spark.tpu.serve.healthProbeSeconds")
 
@@ -235,6 +250,231 @@ def _ab_attempts(session, fleet, args) -> bool:
     return ok
 
 
+# -- fleet family (--family fleet): ownership, epochs, coherence -----------
+
+
+def _owner_of(fed, table: str = "t"):
+    """(owner replica id, shard key) of ``table`` under the current
+    ownership map."""
+    snap = fed.ownership.snapshot()
+    shard = snap["tables"].get(table)
+    return (snap["shards"].get(shard), shard)
+
+
+def _revive(session, fleet, replica_id: str, host: str, port: int):
+    """Restart a stopped replica on its original port, with its own
+    invalidation-subscribed ResultCache (the ownership-mode shape
+    serve_fleet builds)."""
+    from spark_tpu.serve.ownership import session_invalidation_log
+    from spark_tpu.serve.result_cache import ResultCache
+
+    cache = ResultCache(session.conf).attach_invalidation_log(
+        session_invalidation_log(session))
+    server = ConnectServer(session, host=host, port=port,
+                           replica_id=replica_id,
+                           result_cache=cache).start()
+    # replace the corpse, don't accumulate it: later scenarios find
+    # their victim by replica_id and must get the LIVE server
+    fleet.replicas[:] = [s for s in fleet.replicas
+                         if s.replica_id != replica_id]
+    fleet.replicas.append(server)
+    return server
+
+
+def _live_server(fleet, replica_id: str):
+    """The running ConnectServer with this id (stop() nulls _thread)."""
+    return next(s for s in fleet.replicas
+                if s.replica_id == replica_id
+                and s._thread is not None)
+
+
+def _fleet_kill_owner(session, fleet, args) -> bool:
+    """Kill the replica OWNING table t's shard: a new epoch must mint,
+    the shard must re-map to a survivor, and the workload must stay
+    byte-identical with no hang. The corpse is revived afterwards so
+    later scenarios start from a full fleet."""
+    fed = fleet.router.federation
+    conf = session.conf
+    conf.set("spark.tpu.serve.healthProbeSeconds", 3600.0)
+    victim = None
+    try:
+        fed.probe(force=True)
+        for r in fed.replicas:
+            r.breaker.reset()
+        clean = _workload(session, fleet.url, args.timeout, fleet)
+        owner, shard = _owner_of(fed)
+        if owner is None:
+            print("kill-owner: FAIL (no shard owner learned)")
+            return False
+        epoch0 = fed.ownership.epoch
+        victim = _live_server(fleet, owner)
+        print(f"kill-owner: stopping owner {owner} of shard {shard}")
+        t0 = time.time()
+        victim.stop()
+        after = _workload(session, fleet.url, args.timeout, fleet)
+        elapsed = time.time() - t0
+        new_owner, _ = _owner_of(fed)
+        ok = (after == clean
+              and fed.ownership.epoch > epoch0
+              and new_owner not in (None, owner)
+              and elapsed < args.alarm)
+        print(f"  epoch {epoch0}->{fed.ownership.epoch}, owner "
+              f"{owner}->{new_owner}, bytes "
+              f"{'identical' if after == clean else 'MISMATCH'}, "
+              f"{elapsed:.1f}s -> {'ok' if ok else 'FAIL'}")
+        return ok
+    finally:
+        if victim is not None:
+            _revive(session, fleet, victim.replica_id,
+                    victim.host, victim.port)
+        conf.unset("spark.tpu.serve.healthProbeSeconds")
+        fed.probe(force=True)
+
+
+def _fleet_kill_revive_owner(session, fleet, args) -> bool:
+    """Kill the owner, serve through the failover map, revive the SAME
+    replica id on the SAME port: ANOTHER epoch must mint on rejoin,
+    the shard must return to its rendezvous owner, and bytes must hold
+    through the whole death->failover->rejoin arc."""
+    fed = fleet.router.federation
+    conf = session.conf
+    conf.set("spark.tpu.serve.healthProbeSeconds", 3600.0)
+    conf.set("spark.tpu.serve.breaker.openSeconds", 0.3)
+    try:
+        fed.probe(force=True)
+        for r in fed.replicas:
+            r.breaker.reset()
+        clean = _workload(session, fleet.url, args.timeout, fleet)
+        owner, shard = _owner_of(fed)
+        if owner is None:
+            print("kill-and-revive-owner: FAIL (no owner learned)")
+            return False
+        epoch0 = fed.ownership.epoch
+        victim = _live_server(fleet, owner)
+        host, port = victim.host, victim.port
+        print(f"kill-and-revive-owner: stopping owner {owner}")
+        victim.stop()
+        during = _workload(session, fleet.url, args.timeout, fleet)
+        epoch_failover = fed.ownership.epoch
+        _revive(session, fleet, owner, host, port)
+        time.sleep(0.35)  # openSeconds elapses -> half-open probe
+        fed.probe(force=True)  # rejoin: membership change, new epoch
+        after = _workload(session, fleet.url, args.timeout, fleet)
+        back_owner, _ = _owner_of(fed)
+        ok = (during == clean and after == clean
+              and epoch_failover > epoch0
+              and fed.ownership.epoch > epoch_failover
+              and back_owner == owner)
+        print(f"  epochs {epoch0}->{epoch_failover}->"
+              f"{fed.ownership.epoch}, shard owner back on "
+              f"{back_owner} -> {'ok' if ok else 'FAIL'}")
+        return ok
+    finally:
+        conf.unset("spark.tpu.serve.healthProbeSeconds")
+        conf.unset("spark.tpu.serve.breaker.openSeconds")
+        fed.probe(force=True)
+
+
+def _fleet_partition(session, fleet, args) -> bool:
+    """Partition the router from one live replica (its URL is swapped
+    for a black hole — the replica itself never dies): dispatch trips
+    the breaker, ownership re-maps, queries route around it. Healing
+    the partition and re-probing rejoins it with another epoch."""
+    fed = fleet.router.federation
+    conf = session.conf
+    conf.set("spark.tpu.serve.healthProbeSeconds", 3600.0)
+    try:
+        fed.probe(force=True)
+        for r in fed.replicas:
+            r.breaker.reset()
+        clean = _workload(session, fleet.url, args.timeout, fleet)
+        owner, _ = _owner_of(fed)
+        rep = next(r for r in fed.replicas if r.id == owner)
+        real_url = rep.url
+        epoch0 = fed.ownership.epoch
+        # a port nothing listens on: connection refused = partition
+        rep.url = "http://127.0.0.1:9"
+        print(f"partition-router-from-replica: black-holing {owner}")
+        during = _workload(session, fleet.url, args.timeout, fleet)
+        partitioned = (during == clean
+                       and fed.ownership.epoch > epoch0
+                       and rep.breaker.state == "open")
+        rep.url = real_url
+        fed.probe(force=True)  # heal: replica rejoins, epoch mints
+        healed_epoch = fed.ownership.epoch
+        after = _workload(session, fleet.url, args.timeout, fleet)
+        ok = (partitioned and after == clean
+              and rep.healthy and healed_epoch > epoch0 + 1)
+        print(f"  routed-around={'ok' if partitioned else 'FAIL'}, "
+              f"rejoin epoch={healed_epoch}, bytes "
+              f"{'identical' if after == clean else 'MISMATCH'} "
+              f"-> {'ok' if ok else 'FAIL'}")
+        return ok
+    finally:
+        conf.unset("spark.tpu.serve.healthProbeSeconds")
+        fed.probe(force=True)
+
+
+def _fleet_stale_read(session, fleet, args) -> bool:
+    """Append to table t's backing file while every replica holds a
+    TTL'd fingerprint probe AND a cached result: the invalidation
+    broadcast (not TTL expiry) must kill the stale window. The check
+    reads through replicas that never touched the source after the
+    append — their only signal is the broadcast. Runs LAST (it grows
+    table t)."""
+    conf = session.conf
+    q = "SELECT a, b FROM t WHERE a >= 8"
+    path = session._chaos_tables["t"]
+    conf.set("spark.tpu.serve.fingerprintCacheSeconds", 300.0)
+    try:
+        _clear_caches(session, fleet)
+        live = [s for s in fleet.replicas
+                if getattr(s, "_thread", None) is not None]
+        # warm every replica DIRECTLY: pre-append bytes + fp probe
+        pre = {}
+        for s in live:
+            c = Client(s.url, timeout=args.timeout, retries=3)
+            pre[s.replica_id] = _result_bytes(c.sql(q))
+            assert c.last_query["cache"] in ("miss", "hit")
+        # the append commits
+        old = pq.read_table(path)
+        grown = pa.concat_tables([old, pa.table({
+            "a": [1000 + i for i in range(8)],
+            "b": [float(i) for i in range(8)]})])
+        pq.write_table(grown, path)
+        # the appender's own re-read detects the rewrite and
+        # broadcasts the invalidation fleet-wide
+        log = session.serve_invalidation_log
+        v0 = log.version
+        session.sql("SELECT COUNT(*) AS n FROM t").collect()
+        if log.version <= v0:
+            print("stale-read: FAIL (no invalidation broadcast)")
+            return False
+        stale = []
+        for s in live:
+            c = Client(s.url, timeout=args.timeout, retries=3)
+            got = _result_bytes(c.sql(q))
+            if got == pre[s.replica_id]:
+                stale.append(s.replica_id)
+        ok = not stale
+        print(f"stale-read: broadcast v{v0}->{log.version}, "
+              f"{len(live)} replicas re-read fresh"
+              + (f", STALE on {stale}" if stale else "")
+              + f" -> {'ok' if ok else 'FAIL'}")
+        return ok
+    finally:
+        conf.unset("spark.tpu.serve.fingerprintCacheSeconds")
+        _clear_caches(session, fleet)
+
+
+def _fleet_scenarios(session, fleet, args) -> bool:
+    ok = _fleet_kill_owner(session, fleet, args)
+    ok = _fleet_kill_revive_owner(session, fleet, args) and ok
+    ok = _fleet_partition(session, fleet, args) and ok
+    ok = _fleet_stale_read(session, fleet, args) and ok
+    return ok
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -252,18 +492,32 @@ def main(argv=None) -> int:
     ap.add_argument("--replay", default=None,
                     help="re-run one failing schedule from artifact")
     ap.add_argument("--skip-scenarios", action="store_true",
-                    help="random sweep only (no kill-revive / A/B)")
+                    help="random sweep only (no directed scenarios)")
+    ap.add_argument("--family", choices=("core", "fleet"),
+                    default="core",
+                    help="core = policy-routed fleet + kill-revive/AB "
+                         "scenarios; fleet = ownership mode (epochs, "
+                         "owner routing, coherent caches) + "
+                         "kill-owner / kill-and-revive-owner / "
+                         "partition / stale-read scenarios")
     args = ap.parse_args(argv)
 
     with tempfile.TemporaryDirectory() as tmp:
         session = _make_session(tmp)
+        if args.family == "fleet":
+            session.conf.set("spark.tpu.serve.ownership.enabled", True)
+            session.conf.set("spark.tpu.serve.resultCache.enabled",
+                             True)
         fleet = serve_fleet(session, replicas=args.replicas)
         try:
             if args.replay:
                 ok = _replay(session, fleet, args)
             else:
                 ok = _campaign(session, fleet, args)
-                if not args.skip_scenarios:
+                if not args.skip_scenarios \
+                        and args.family == "fleet":
+                    ok = _fleet_scenarios(session, fleet, args) and ok
+                elif not args.skip_scenarios:
                     ok = _kill_revive(session, fleet, args) and ok
                     ok = _ab_attempts(session, fleet, args) and ok
         finally:
