@@ -109,7 +109,7 @@ def _query_deadline(extra_s: float = 0.0, cap_s: float = None) -> float:
 PHASE_BUDGET_S = {
     "cached": 180.0, "adaptive": 240.0, "serving": 240.0,
     "serve": 240.0, "fleet": 240.0, "mview": 180.0, "agg": 420.0,
-    "join": 420.0, "trace": 150.0,
+    "join": 420.0, "trace": 150.0, "slo": 300.0,
 }
 
 
@@ -185,6 +185,13 @@ TRACE_MODE = os.environ.get("BENCH_TRACE", "1") == "1"
 # shard-ownership routing on; per-cell byte-identity against the
 # 1-replica cell lands under 'fleet' in the result JSON)
 FLEET_MODE = os.environ.get("BENCH_FLEET", "1") == "1"
+
+# BENCH_SLO=0 skips the SLO serving A/B (needs --concurrency): the
+# golden q1/q3/q5 mix under ~2x closed-loop overload with per-query
+# deadlines, FIFO vs SLO mode (EDF + reject-at-admission); successful-
+# within-SLO counts, p99, shed counts and byte-identity land under
+# 'slo' in the result JSON
+SLO_MODE = os.environ.get("BENCH_SLO", "1") == "1"
 
 
 def _warmup_child() -> None:
@@ -458,6 +465,188 @@ def _run_serving(spark, concurrency: int, queries: dict,
         "byte_identical_to_serial": not mismatched and not errors,
         "mismatched_queries": sorted(set(mismatched)),
     }
+
+
+def _run_slo_ab(spark, concurrency: int,
+                duration_s: float = 6.0,
+                slo_multiplier: float = 3.0) -> dict:
+    """SLO serving A/B (ROADMAP item 5 acceptance): the golden q1/q3/q5
+    mix driven closed-loop at ~2x overload (clients >> workers), each
+    query carrying its own deadline (the stated SLO: ``slo_multiplier``
+    x that query's warm serial latency), once through the plain FIFO
+    scheduler and once with spark.tpu.slo.enabled — per-plan latency
+    prediction, EDF ordering, and reject-at-admission. Both arms run
+    the same fixed wall-clock window, so the within-SLO counts are
+    directly comparable goodput. The claim under test: the SLO arm
+    serves MORE queries successfully WITHIN their deadlines (doomed
+    queries are shed in milliseconds at admission instead of rotting in
+    the queue and making every other query late; tight-deadline queries
+    jump the EDF queue instead of waiting behind long scans) and its
+    successes meet the stated SLO at p99. Every completed result is
+    checked byte-identical against a serial reference — shedding may
+    drop queries, it must never change bytes."""
+    import threading
+
+    from spark_tpu import metrics
+    from spark_tpu.scheduler import QueryScheduler
+    from spark_tpu.slo.edf import InfeasibleDeadline
+    from spark_tpu.tpch.queries import QUERIES
+
+    queries = {q: QUERIES[q] for q in (1, 3, 5)}
+    # serial reference (also the warm-up: compiles once, off the clock)
+    ref = {q: spark.sql(sql).toArrow() for q, sql in queries.items()}
+    run_ms = {}
+    for q, sql in queries.items():
+        t0 = time.perf_counter()
+        spark.sql(sql).toArrow()
+        run_ms[q] = (time.perf_counter() - t0) * 1e3
+    deadline_ms = {q: slo_multiplier * v for q, v in run_ms.items()}
+    workers = 2
+    n_clients = max(2 * workers, concurrency)
+
+    def arm(slo_on: bool) -> dict:
+        conf = spark.conf
+        conf.set("spark.tpu.scheduler.maxConcurrency", workers)
+        conf.set("spark.tpu.scheduler.queueDepth", 64)
+        conf.set("spark.tpu.slo.enabled", slo_on)
+        if slo_on:
+            conf.set("spark.tpu.slo.targetP99Ms",
+                     max(deadline_ms.values()))
+            # predictions come from warm serial observations but the
+            # measured window runs contended; the margin sheds
+            # marginal admissions so what IS admitted finishes inside
+            # its deadline (the sizing guidance the README documents)
+            conf.set("spark.tpu.slo.rejectMargin", 1.5)
+            metrics.reset_slo()
+        sched = None
+        try:
+            sched = QueryScheduler(spark)
+            # train off the clock — identical protocol both arms (the
+            # SLO arm's latency model learns each query's fingerprint;
+            # the FIFO arm just re-warms the same caches)
+            for q, sql in queries.items():
+                for _ in range(2):
+                    sched.submit_query(
+                        lambda sql=sql: spark.sql(sql),
+                        sql=sql).result(QUERY_TIMEOUT_S)
+            time.sleep(0.1)  # let the trailing observations land
+            lock = threading.Lock()
+            lat, ratios, mismatched, errors = [], [], [], []
+            within = [0]
+            rejected = [0]
+            missed = [0]
+            t_end = time.perf_counter() + duration_s
+
+            def client(idx: int) -> None:
+                i = 0
+                order = sorted(queries)
+                while time.perf_counter() < t_end:
+                    qnum = order[(idx + i) % len(order)]
+                    i += 1
+                    sql = queries[qnum]
+                    t0 = time.perf_counter()
+                    try:
+                        t = sched.submit_query(
+                            lambda sql=sql: spark.sql(sql),
+                            deadline_s=deadline_ms[qnum] / 1e3,
+                            sql=sql,
+                            description=f"slo q{qnum} c{idx}")
+                        tbl = t.result(QUERY_TIMEOUT_S)
+                    except InfeasibleDeadline:
+                        with lock:
+                            rejected[0] += 1
+                        # the shed cost the client microseconds; a real
+                        # caller backs off for its SLO window instead
+                        # of hammering admission in a tight loop
+                        time.sleep(deadline_ms[qnum] / 1e3)
+                        continue
+                    except Exception as e:
+                        with lock:
+                            missed[0] += 1
+                            errors.append(
+                                f"q{qnum}: {type(e).__name__}")
+                        continue
+                    ms = (time.perf_counter() - t0) * 1e3
+                    okq = tbl.equals(ref[qnum])
+                    with lock:
+                        lat.append(ms)
+                        ratios.append(ms / deadline_ms[qnum])
+                        if not okq:
+                            mismatched.append(qnum)
+                        if ms <= deadline_ms[qnum]:
+                            within[0] += 1
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.perf_counter() - t0
+        finally:
+            if sched is not None:
+                sched.stop()
+            conf.unset("spark.tpu.scheduler.maxConcurrency")
+            conf.unset("spark.tpu.scheduler.queueDepth")
+            conf.unset("spark.tpu.slo.enabled")
+            if slo_on:
+                conf.unset("spark.tpu.slo.targetP99Ms")
+                conf.unset("spark.tpu.slo.rejectMargin")
+        offered = len(lat) + rejected[0] + missed[0]
+        # typed deadline outcomes (late death under FIFO, early shed
+        # under SLO) are EXPECTED under overload and reported above;
+        # byte-identity is about the bytes actually served
+        return {
+            "policy": "EDF+reject" if slo_on else "FIFO",
+            "offered": offered,
+            "completed": len(lat),
+            "within_slo": within[0],
+            "within_slo_per_s": round(within[0] / wall_s, 2)
+            if wall_s else 0.0,
+            "rejected_at_admission": rejected[0],
+            "missed_or_failed": missed[0],
+            "wall_s": round(wall_s, 2),
+            "p50_ms": round(_percentile(lat, 50), 1),
+            "p99_ms": round(_percentile(lat, 99), 1),
+            # latency normalized by each query's OWN deadline: <= 1.0
+            # at p99 means the served stream met the stated SLO
+            "p99_slo_ratio": round(_percentile(ratios, 99), 2),
+            "byte_identical_to_serial": not mismatched,
+            "mismatched_queries": sorted(set(mismatched)),
+            "errors": errors[:10],
+            **({"slo_counters": metrics.slo_stats()} if slo_on else {}),
+        }
+
+    out = {"stated_slo": f"{slo_multiplier:g}x warm serial latency "
+                         "per query",
+           "deadline_ms": {str(q): round(v, 1)
+                           for q, v in deadline_ms.items()},
+           "workers": workers, "clients": n_clients,
+           "duration_s": duration_s,
+           "overload_factor": round(n_clients / workers, 1),
+           "serial_run_ms": {str(q): round(v, 1)
+                             for q, v in run_ms.items()}}
+    out["fifo"] = arm(False)
+    if _wall_remaining() <= 10:
+        out["slo"] = {"error": "skipped: wall budget exhausted"}
+        return out
+    out["slo"] = arm(True)
+    f, s = out["fifo"], out["slo"]
+    out["within_slo_improvement"] = (
+        round(s["within_slo"] / f["within_slo"], 2)
+        if f.get("within_slo") else
+        ("inf" if s.get("within_slo") else 0.0))
+    # stated SLO met at p99 when the 99th-percentile served latency,
+    # each query normalized by its OWN deadline, lands at-or-under 1.0
+    out["meets_stated_slo_p99"] = bool(
+        s.get("within_slo", 0) > 0
+        and s.get("p99_slo_ratio", 99.0) <= 1.0)
+    out["byte_identical"] = (
+        f.get("byte_identical_to_serial", False)
+        and s.get("byte_identical_to_serial", False))
+    return out
 
 
 def _run_serve_ab(spark, concurrency: int, replicas_n: int,
@@ -1018,6 +1207,24 @@ def main():
                 serving = {"error": f"{type(e).__name__}: {e}"}
         _phase_snapshot(serving=serving)
 
+    slo_ab = None
+    if SLO_MODE and args.concurrency > 0:
+        if _wall_remaining() <= 5:
+            slo_ab = _budget_skip("slo")
+        else:
+            print(f"[bench] slo A/B: q1/q3/q5 with deadlines at ~2x "
+                  f"closed-loop overload, FIFO vs EDF+reject "
+                  f"({max(4, args.concurrency)} clients)",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_phase_deadline("slo")):
+                    slo_ab = _run_slo_ab(spark, args.concurrency)
+            except _QueryTimeout:
+                slo_ab = {"error": "timeout"}
+            except Exception as e:
+                slo_ab = {"error": f"{type(e).__name__}: {e}"}
+        _phase_snapshot(slo=slo_ab)
+
     serve_ab = None
     if args.replicas > 0 and args.concurrency > 0:
         if _wall_remaining() <= 5:
@@ -1154,6 +1361,7 @@ def main():
         **({"cached": cached} if cached is not None else {}),
         **({"adaptive": adaptive} if adaptive is not None else {}),
         **({"serving": serving} if serving is not None else {}),
+        **({"slo": slo_ab} if slo_ab is not None else {}),
         **({"serve": serve_ab} if serve_ab is not None else {}),
         **({"fleet": fleet_bench} if fleet_bench is not None else {}),
         **({"mview": mview} if mview is not None else {}),

@@ -160,13 +160,15 @@ def is_typed_error(exc: BaseException) -> bool:
         name = type(e).__name__
         if name in ("QueryCancelled", "SchedulerQueueFull",
                     "NoHealthyReplica", "FlightWaitTimeout",
-                    "PlanAnalysisError", "EpochRetry"):
+                    "PlanAnalysisError", "EpochRetry",
+                    "InfeasibleDeadline"):
             return True
         msg = str(e)
         return any(m in msg for m in (
             "DATA_LOSS", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED",
             "UNAVAILABLE", "RETRY_BUDGET_EXHAUSTED", "CANCELLED",
-            "SchedulerQueueFull", "NoHealthyReplica", "EPOCH_RETRY"))
+            "SchedulerQueueFull", "NoHealthyReplica", "EPOCH_RETRY",
+            "INFEASIBLE_DEADLINE"))
 
     seen = set()
     e: Optional[BaseException] = exc

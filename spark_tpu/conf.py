@@ -527,6 +527,89 @@ SERVE_REPLICAS = register(
     "pass one explicitly.", int)
 
 
+# ---- SLO-driven serving (spark_tpu/slo/) ----------------------------------
+
+SLO_ENABLED = register(
+    "spark.tpu.slo.enabled", False,
+    "Master switch for the SLO subsystem: per-plan latency prediction "
+    "(slo/model.py), earliest-feasible-deadline-first scheduling with "
+    "reject-at-admission (slo/edf.py), and predictive brownout / "
+    "concurrency auto-sizing (slo/controller.py). Off is byte-identical "
+    "to the plain FIFO/FAIR scheduler path.", bool)
+
+SLO_TARGET_P99_MS = register(
+    "spark.tpu.slo.targetP99Ms", 0.0,
+    "Configured p99 latency SLO in milliseconds. When > 0 the "
+    "predictive brownout controller enters brownout as soon as the "
+    "PREDICTED p99 over the recent window crosses it (before failures "
+    "accumulate), and exits once predictions drop back under "
+    "exitRatio x target. 0 disables predictive brownout.", float)
+
+SLO_REJECT_ENABLED = register(
+    "spark.tpu.slo.rejectEnabled", True,
+    "Reject-at-admission (only active under spark.tpu.slo.enabled): a "
+    "submit whose predicted completion (queue backlog estimate + "
+    "predicted run time) exceeds its deadline raises the typed "
+    "InfeasibleDeadline immediately instead of burning queue slots and "
+    "device time on a query that is doomed to miss.", bool)
+
+SLO_REJECT_MARGIN = register(
+    "spark.tpu.slo.rejectMargin", 1.0,
+    "Safety factor on the predicted completion time before the "
+    "infeasibility comparison (>1 rejects earlier, <1 gives doubtful "
+    "queries the benefit of the doubt).", float)
+
+SLO_MODEL_ALPHA = register(
+    "spark.tpu.slo.model.alpha", 0.3,
+    "EWMA smoothing factor for the per-plan-fingerprint latency model "
+    "components (host/device/queue/transfer ms and input rows); higher "
+    "adapts faster, lower is steadier.", float)
+
+SLO_MODEL_PATH = register(
+    "spark.tpu.slo.model.path", "",
+    "Persistence file (JSONL) for the latency model. Empty defaults to "
+    "<compile store root>/slo_model.jsonl beside the plan-history "
+    "journal when the store is enabled, so a restarted replica "
+    "predicts from its first query; otherwise the model is "
+    "in-memory only.", str)
+
+SLO_MODEL_MAX_ENTRIES = register(
+    "spark.tpu.slo.model.maxEntries", 512,
+    "Distinct plan fingerprints kept by the latency model (LRU beyond "
+    "it; the journal is compacted past roughly twice this many lines).",
+    int)
+
+SLO_WINDOW_SECONDS = register(
+    "spark.tpu.slo.controller.windowSeconds", 30.0,
+    "Sliding window over which the controller aggregates predicted "
+    "per-query latencies for the predictive-p99 brownout decision.",
+    float)
+
+SLO_MIN_PREDICTIONS = register(
+    "spark.tpu.slo.controller.minPredictions", 8,
+    "Minimum predictions inside the window before the predictive "
+    "brownout level may change (a single slow cold query is not a "
+    "p99).", int)
+
+SLO_EXIT_RATIO = register(
+    "spark.tpu.slo.controller.exitRatio", 0.8,
+    "Hysteresis for predictive brownout exit: the level drops back to "
+    "0 only once predicted p99 <= exitRatio x targetP99Ms.", float)
+
+SLO_AUTOSIZE_ENABLED = register(
+    "spark.tpu.slo.autoConcurrency.enabled", True,
+    "Auto-size the scheduler's EFFECTIVE concurrency (only under "
+    "spark.tpu.slo.enabled) from observed queue/device-time ratios: "
+    "queue-dominated load shrinks the effective worker count toward "
+    "autoConcurrency.min (less churn at the device gate), "
+    "compute-headroom grows it back toward the configured "
+    "maxConcurrency.", bool)
+
+SLO_AUTOSIZE_MIN = register(
+    "spark.tpu.slo.autoConcurrency.min", 1,
+    "Floor for the auto-sized effective concurrency.", int)
+
+
 # ---- materialized views (spark_tpu/mview/) --------------------------------
 
 MVIEW_ENABLED = register(

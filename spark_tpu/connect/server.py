@@ -7,6 +7,7 @@ from __future__ import annotations
 import io
 import json
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -22,6 +23,7 @@ class ConnectServer:
                  heartbeat=None, scheduler=None,
                  replica_id: Optional[str] = None, result_cache=None):
         from spark_tpu.scheduler import QueryScheduler, SchedulerQueueFull
+        from spark_tpu.slo.edf import InfeasibleDeadline
 
         self.session = session
         #: serve-tier plan-keyed result cache, shared across every
@@ -323,6 +325,7 @@ class ConnectServer:
                             headers["X-Query-Id"] = str(t.id)
                             headers["X-Queue-Wait-Ms"] = \
                                 f"{t.queue_wait_ms():.2f}"
+                        headers.update(outer._slo_headers(t))
                         self._send(
                             200, blob,
                             "application/vnd.apache.arrow.stream",
@@ -340,7 +343,8 @@ class ConnectServer:
                             "X-Query-Id": str(ticket.id),
                             "X-Queue-Wait-Ms":
                                 f"{ticket.queue_wait_ms():.2f}",
-                            "X-SparkTpu-Replica": outer.replica_id})
+                            "X-SparkTpu-Replica": outer.replica_id,
+                            **outer._slo_headers(ticket)})
                 except SchedulerQueueFull as e:
                     # backpressure, not failure: the client should back
                     # off and retry (Client honors Retry-After); the
@@ -356,6 +360,32 @@ class ConnectServer:
                                        f"{e.retry_after_s:g}",
                                    "X-SparkTpu-Replica":
                                        outer.replica_id})
+                except InfeasibleDeadline as e:
+                    # SLO reject-at-admission: the latency model says
+                    # this query cannot finish inside its deadline, so
+                    # it was shed BEFORE costing a queue slot or any
+                    # device time. 503 (not 429): the queue is not
+                    # full — retrying the same replica with the same
+                    # deadline yields the same prediction. The
+                    # federation router may still re-dispatch it to a
+                    # less-loaded replica under the retry budget.
+                    metrics.record("serve", phase="slo_reject",
+                                   replica=outer.replica_id,
+                                   predicted_ms=round(e.predicted_ms, 2))
+                    body = json.dumps(
+                        {"error": "InfeasibleDeadline",
+                         "message": str(e),
+                         "predicted_ms": round(e.predicted_ms, 3),
+                         "queue_ms": round(e.queue_ms, 3),
+                         "run_ms": round(e.run_ms, 3),
+                         "deadline": e.deadline}).encode()
+                    self._send(503, body, "application/json",
+                               headers={
+                                   "X-SparkTpu-Predicted-Ms":
+                                       f"{e.predicted_ms:.2f}",
+                                   "X-SparkTpu-Sched-Policy": "EDF",
+                                   "X-SparkTpu-Replica":
+                                       outer.replica_id})
                 except Exception as e:  # error -> JSON with message
                     body = json.dumps(
                         {"error": type(e).__name__,
@@ -369,6 +399,23 @@ class ConnectServer:
         #: defaults to the bound port (unique per in-process fleet)
         self.replica_id = replica_id or f"r{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    # -- SLO surface -----------------------------------------------------------
+
+    def _slo_headers(self, ticket=None) -> dict:
+        """Response headers surfacing the SLO outcome (predicted
+        latency, scheduling policy, predictive-brownout level). Empty
+        when spark.tpu.slo.enabled is off so the off-path response is
+        byte-identical to the pre-SLO server."""
+        if getattr(self.scheduler, "_slo", None) is None:
+            return {}
+        h = {"X-SparkTpu-Sched-Policy": "EDF",
+             "X-SparkTpu-Brownout": str(metrics.brownout_level())}
+        pred = getattr(ticket, "slo_predicted_ms", None) \
+            if ticket is not None else None
+        if pred is not None:
+            h["X-SparkTpu-Predicted-Ms"] = f"{pred:.2f}"
+        return h
 
     # -- fleet ownership ------------------------------------------------------
 
@@ -642,6 +689,7 @@ class Client:
         req = urllib.request.Request(
             self.url + path,
             data=json.dumps(payload).encode(), headers=headers)
+        t0 = time.monotonic()
         try:
             with urllib.request.urlopen(req,
                                         timeout=timeout) as resp:
@@ -653,6 +701,7 @@ class Client:
                 if tid:
                     self.last_trace_id = tid
                 epoch = resp.headers.get("X-SparkTpu-Epoch")
+                pred = resp.headers.get("X-SparkTpu-Predicted-Ms")
                 self.last_query = {
                     "replica": rid,
                     "cache": resp.headers.get("X-Cache"),
@@ -661,9 +710,47 @@ class Client:
                     "queue_wait_ms":
                         resp.headers.get("X-Queue-Wait-Ms"),
                     "trace_id": tid,
+                    # SLO outcome: predicted vs (client-measured)
+                    # actual latency, the policy that scheduled it,
+                    # and reject/brownout status — None/False with
+                    # SLO off, so consumers need no feature check
+                    "slo_predicted_ms":
+                        float(pred) if pred else None,
+                    "slo_actual_ms": round(
+                        (time.monotonic() - t0) * 1e3, 2),
+                    "sched_policy": resp.headers.get(
+                        "X-SparkTpu-Sched-Policy"),
+                    "brownout": resp.headers.get("X-SparkTpu-Brownout"),
+                    "slo_rejected": False,
                 }
         except urllib.error.HTTPError as e:
             detail = json.loads(e.read())
+            if e.code == 503 \
+                    and detail.get("error") == "InfeasibleDeadline":
+                # typed SLO reject: NOT retried here (same replica +
+                # same deadline = same prediction); surfaces to the
+                # caller with the prediction that condemned it
+                from spark_tpu.slo.edf import InfeasibleDeadline
+
+                rid = e.headers.get("X-SparkTpu-Replica")
+                if rid:
+                    self.affinity = rid
+                self.last_query = {
+                    "replica": rid,
+                    "slo_predicted_ms": detail.get("predicted_ms"),
+                    "slo_actual_ms": round(
+                        (time.monotonic() - t0) * 1e3, 2),
+                    "sched_policy": e.headers.get(
+                        "X-SparkTpu-Sched-Policy"),
+                    "brownout": e.headers.get("X-SparkTpu-Brownout"),
+                    "slo_rejected": True,
+                }
+                raise InfeasibleDeadline(
+                    float(detail.get("predicted_ms") or 0.0),
+                    float(detail.get("deadline") or 0.0),
+                    queue_ms=float(detail.get("queue_ms") or 0.0),
+                    run_ms=float(detail.get("run_ms") or 0.0)) \
+                    from None
             if e.code == 429:
                 ra = e.headers.get("Retry-After") \
                     or detail.get("retry_after_s") or 0.0

@@ -76,6 +76,18 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          static partial->final strategy — like
                          ``agg.strategy``, the candidate list is pure
                          advice and is discarded whole on failure
+- ``slo.predict``        the SLO latency-model prediction at submit
+                         time (slo/controller.py, OUTSIDE the
+                         scheduler's condition lock): ANY kind is
+                         absorbed as "no prediction" — the query is
+                         treated FIFO-equivalent (always feasible, no
+                         EDF advantage), bytes never depend on the
+                         model
+- ``slo.reject``         the reject-at-admission decision gate
+                         (slo/controller.py): ANY kind FAILS OPEN —
+                         the feasibility check is skipped and the
+                         query admitted, so injection can only admit
+                         more than policy would, never shed spuriously
 - ``join.spill``         the hybrid hash join's host-spill seams
                          (physical/chunked.py _HybridHashJoinAgg):
                          spill-file WRITE during the partition pass,
@@ -153,6 +165,8 @@ POINTS = (
     "agg.strategy",
     "agg.presplit",
     "join.spill",
+    "slo.predict",
+    "slo.reject",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

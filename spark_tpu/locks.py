@@ -51,6 +51,8 @@ LOCK_RANKS: Dict[str, int] = {
     # --- scheduler / execution ---------------------------------------
     "scheduler.cond": 300,           # QueryScheduler._cond: queue+gate
     "scheduler.pools": 310,          # PoolRegistry._lock
+    "slo.model": 320,                # LatencyModel EWMA state + journal
+    "slo.controller": 325,           # SloController window/resize state
     "pipeline.cond": 350,            # ChunkPipeline._cond: inflight budget
     "serve.invalidation": 355,       # InvalidationLog ring + subscribers
     "serve.result_cache": 360,       # ResultCache._flights map

@@ -381,6 +381,36 @@ def reset_serve() -> None:
             _SERVE[k] = 0
 
 
+# ---- SLO serving counters ---------------------------------------------------
+
+#: the SLO subsystem (spark_tpu/slo/) — submit-time predictions made,
+#: finished queries folded back into the latency model, typed
+#: InfeasibleDeadline rejects at admission, predictive brownout
+#: transitions (predicted p99 vs target, distinct from the serve
+#: tier's failure-driven brownout), effective-concurrency resizes, and
+#: model-journal entries loaded at startup. Shown in scheduler.status
+#: and /health.
+_SLO = {"predictions": 0, "observations": 0, "rejects": 0,
+        "brownout_enters": 0, "brownout_exits": 0, "resizes": 0,
+        "loads": 0}
+
+
+def note_slo(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _SLO[kind] = _SLO.get(kind, 0) + int(n)
+
+
+def slo_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_SLO)
+
+
+def reset_slo() -> None:
+    with _LOCK:
+        for k in list(_SLO):
+            _SLO[k] = 0
+
+
 # ---- adaptive-aggregation counters ------------------------------------------
 
 #: the runtime-adaptive aggregation engine (parallel/executor.py) —

@@ -41,6 +41,7 @@ from spark_tpu import faults, metrics
 from spark_tpu.scheduler.admission import (AdmissionController,
                                            estimate_plan_bytes)
 from spark_tpu.scheduler.pool import PoolRegistry
+from spark_tpu.slo.edf import edf_key
 
 QUEUED = "QUEUED"
 ADMITTED = "ADMITTED"
@@ -97,6 +98,12 @@ class QueryTicket:
         self.retry_budget = None
         self._granted = False  # holding an admission grant (charge may
         # legitimately be 0 when storage eviction covered the footprint)
+        #: SLO stamps — all None/False unless spark.tpu.slo.enabled
+        self.slo_fp: Optional[str] = None
+        self.slo_rows: Optional[float] = None
+        self.slo_run_pred_ms: Optional[float] = None
+        self.slo_predicted_ms: Optional[float] = None
+        self._slo_picked = False
         # span context of the submitting thread (connect request /
         # client): workers re-enter it so the whole execution — stages,
         # faults, retries — attributes to the submitter's trace
@@ -153,6 +160,10 @@ class QueryTicket:
             "device_ms": round(self.device_ms, 2),
             "error": repr(self.error) if self.error is not None
             else None,
+            # SLO fields appear only when the subsystem predicted this
+            # query (absent = payload byte-identical to the pre-SLO one)
+            **({"slo_predicted_ms": round(self.slo_predicted_ms, 2)}
+               if self.slo_predicted_ms is not None else {}),
         }
 
 
@@ -194,11 +205,30 @@ class QueryScheduler:
         self._recent: deque = deque(maxlen=256)  # finished + live tickets
         self._stopped = False
         self.rejected = 0
+        n_workers = max(1, int(conf.get(CF.SCHEDULER_MAX_CONCURRENCY)))
+        # SLO control plane (ROADMAP item 5): None unless
+        # spark.tpu.slo.enabled — every SLO branch below is guarded on
+        # ``self._slo is not None`` so the FIFO/FAIR paths are
+        # byte-identical to the pre-SLO scheduler when off
+        self._slo = None
+        self._active_runs = 0  # tickets picked and not yet finished
+        if bool(conf.get(CF.SLO_ENABLED)):
+            try:
+                from spark_tpu.slo.controller import SloController
+                from spark_tpu.slo.model import (LatencyModel,
+                                                 model_path_from_conf)
+
+                model = LatencyModel(
+                    model_path_from_conf(conf),
+                    alpha=float(conf.get(CF.SLO_MODEL_ALPHA)),
+                    max_entries=int(conf.get(CF.SLO_MODEL_MAX_ENTRIES)))
+                self._slo = SloController(conf, model, n_workers)
+            except Exception:
+                self._slo = None  # SLO is advisory: never block startup
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"spark-tpu-sched-{i}")
-            for i in range(max(1, int(
-                conf.get(CF.SCHEDULER_MAX_CONCURRENCY))))]
+            for i in range(n_workers)]
         for w in self._workers:
             w.start()
 
@@ -207,12 +237,18 @@ class QueryScheduler:
     def submit(self, run: Callable, *, prepare: Optional[Callable] = None,
                pool: Optional[str] = None, description: str = "",
                est_bytes: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> QueryTicket:
+               deadline_s: Optional[float] = None,
+               slo_fp: Optional[str] = None,
+               slo_rows: Optional[float] = None) -> QueryTicket:
         """Queue a query. ``prepare(ticket)`` is the host-side stage
         (parse/optimize/estimate; runs concurrently on the worker pool,
         may return a refined est_bytes); ``run(ticket)`` is the
         device-side stage, entered only after HBM admission. Raises
-        SchedulerQueueFull at full queue depth."""
+        SchedulerQueueFull at full queue depth, and — when
+        spark.tpu.slo.enabled with a deadline set and ``slo_fp`` known
+        to the latency model — InfeasibleDeadline when predicted
+        completion already exceeds the deadline (reject-at-admission:
+        the query is shed before it costs a queue slot)."""
         p = self.pools.get(pool)
         deadline = time.time() + float(deadline_s) \
             if deadline_s is not None else None
@@ -222,6 +258,16 @@ class QueryScheduler:
         if ambient is not None:
             deadline = ambient if deadline is None \
                 else min(deadline, ambient)
+        # prediction + reject-gate fault seams run OUTSIDE the condition
+        # lock (a hang-kind injection must never stall the scheduler
+        # while holding it); the feasibility math itself is pure and
+        # runs under the lock against the live backlog
+        pred_ms = None
+        slo_reject = False
+        if self._slo is not None:
+            pred_ms = self._slo.predict_run_ms(slo_fp, slo_rows)
+            if deadline is not None and pred_ms is not None:
+                slo_reject = self._slo.reject_gate()
         with self._cond:
             if self._stopped:
                 raise RuntimeError("scheduler is stopped")
@@ -230,6 +276,26 @@ class QueryScheduler:
                 metrics.record("scheduler", phase="rejected",
                                pool=p.name, queued=self._queued)
                 raise SchedulerQueueFull(self._queued, self.retry_after_s)
+            predicted_total = None
+            if self._slo is not None and pred_ms is not None:
+                # the queue-wait model must match the EDF pick: a
+                # deadlined submit only waits behind queued tickets
+                # whose deadline sorts at-or-before its own (it jumps
+                # the rest), while a deadline-less submit sorts last
+                # and waits behind everything; in-flight work can't be
+                # preempted either way
+                predicted_total = self._slo.admission_check_locked(
+                    deadline=deadline, pred_run_ms=pred_ms,
+                    pending_ms=[x.slo_run_pred_ms
+                                for q in self.pools.all()
+                                for x in q.queue
+                                if deadline is None
+                                or (x.deadline is not None
+                                    and x.deadline <= deadline)],
+                    inflight_ms=[x.slo_run_pred_ms
+                                 for x in self._recent
+                                 if x.state in (ADMITTED, RUNNING)],
+                    reject=slo_reject)
             self._seq += 1
             t = QueryTicket(
                 self._seq, pool=p.name, description=description,
@@ -237,6 +303,10 @@ class QueryScheduler:
                 est_bytes=est_bytes if est_bytes is not None
                 else self.admission.budget,
                 deadline=deadline)
+            t.slo_fp = slo_fp
+            t.slo_rows = slo_rows
+            t.slo_run_pred_ms = pred_ms
+            t.slo_predicted_ms = predicted_total
             p.queue.append(t)
             p.running += 1  # dequeued-or-queued live count, see _finish
             self._queued += 1
@@ -266,14 +336,32 @@ class QueryScheduler:
             holder["df"] = df
             conf = df._session.conf if df._session is not None \
                 else self._conf
+            if self._slo is not None:
+                # refine the SLO identity once the plan exists: a
+                # structural fingerprint for SQL-less submissions (so
+                # the model still learns them) and scan-stat input
+                # rows for size-scaled predictions on the next run
+                from spark_tpu.slo.model import (fingerprint_plan,
+                                                 plan_input_rows)
+
+                if t.slo_fp is None:
+                    t.slo_fp = fingerprint_plan(df._plan)
+                if t.slo_rows is None:
+                    t.slo_rows = plan_input_rows(df._plan)
             return estimate_plan_bytes(df._plan, conf)
 
         def run(t: QueryTicket):
             t.check_cancelled()
             return holder["df"].toArrow()
 
+        slo_fp = None
+        if self._slo is not None and sql is not None:
+            from spark_tpu.slo.model import fingerprint_sql
+
+            slo_fp = fingerprint_sql(sql)
         return self.submit(run, prepare=prepare, pool=pool,
-                           description=description, deadline_s=deadline_s)
+                           description=description, deadline_s=deadline_s,
+                           slo_fp=slo_fp)
 
     def cancel(self, qid: int) -> bool:
         """Cancel by id: a QUEUED query finishes CANCELLED right here;
@@ -312,7 +400,7 @@ class QueryScheduler:
 
     def status(self) -> Dict[str, Any]:
         with self._cond:
-            return {
+            st = {
                 "mode": self.mode,
                 "queue_depth": self.max_queue_depth,
                 "queued": self._queued,
@@ -321,6 +409,9 @@ class QueryScheduler:
                 "admission": self.admission.snapshot(),
                 "pools": [p.snapshot() for p in self.pools.all()],
             }
+            if self._slo is not None:
+                st["slo"] = self._slo.snapshot()
+            return st
 
     def describe(self, n: int = 64) -> List[Dict[str, Any]]:
         """Recent + live tickets, newest first (the /queries payload)."""
@@ -333,6 +424,8 @@ class QueryScheduler:
         """Next ticket to dequeue, per policy; purges cancelled and
         deadline-expired queue heads. Caller holds the lock."""
         now = time.time()
+        if self._slo is not None:
+            return self._pick_slo_locked(now)
         for p in self.pools.all():
             while p.queue:
                 head = p.queue[0]
@@ -357,6 +450,41 @@ class QueryScheduler:
         t = best.queue.popleft()
         self._queued -= 1
         return t
+
+    def _pick_slo_locked(self, now: float) -> Optional[QueryTicket]:
+        """SLO pick: earliest-deadline-first across ALL pool queues
+        (not just heads — EDF may owe the next slot to a mid-queue
+        ticket), bounded by the controller's auto-sized effective
+        concurrency. Purges cancelled/expired tickets anywhere in the
+        queues: under EDF an expired ticket is never "in the way" at
+        the head, so head-only purging would leak it. Caller holds
+        the lock."""
+        for p in self.pools.all():
+            for x in list(p.queue):
+                if x.cancelled() or (x.deadline is not None
+                                     and now > x.deadline):
+                    p.queue.remove(x)
+                    self._queued -= 1
+                    why = "cancelled while queued" if x.cancelled() \
+                        else "DEADLINE_EXCEEDED while queued"
+                    self._finish_locked(
+                        x, CANCELLED,
+                        error=QueryCancelled(f"query {x.id} {why}"))
+        if self._active_runs >= self._slo.effective_concurrency():
+            return None  # auto-sized below the worker count: idle some
+        best: Optional[QueryTicket] = None
+        best_pool = None
+        for p in self.pools.all():
+            for x in p.queue:
+                if best is None or edf_key(x) < edf_key(best):
+                    best, best_pool = x, p
+        if best is None:
+            return None
+        best_pool.queue.remove(best)
+        self._queued -= 1
+        best._slo_picked = True
+        self._active_runs += 1
+        return best
 
     def _worker_loop(self) -> None:
         while True:
@@ -406,6 +534,10 @@ class QueryScheduler:
             t.check_cancelled()
             out = t._run(t)
             self._finish(t, FINISHED, result=out)
+            if self._slo is not None:
+                # fold the completed run back into the latency model
+                # (no scheduler lock held here; never raises)
+                self._slo.note_finished(t)
         except (QueryCancelled, DL.DeadlineExceeded) as e:
             self._finish(t, CANCELLED, error=e)
         except Exception as e:  # noqa: BLE001 — typed via ticket.error
@@ -420,6 +552,8 @@ class QueryScheduler:
     def _gate_best_locked(self) -> Optional[QueryTicket]:
         if not self._gate:
             return None
+        if self._slo is not None:
+            return min(self._gate, key=edf_key)
         if self.mode == "FAIR":
             return min(self._gate, key=lambda x:
                        self.pools.get(x.pool).fair_rank() + (x.id,))
@@ -546,6 +680,9 @@ class QueryScheduler:
                        error: Optional[BaseException] = None) -> None:
         if t.done():
             return
+        if t._slo_picked:
+            t._slo_picked = False
+            self._active_runs -= 1
         t.state = state
         t._result = result
         t.error = error

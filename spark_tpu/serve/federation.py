@@ -96,7 +96,9 @@ SERVE_BROWNOUT_MIN_EVENTS = CF.register(
 #: response headers a replica sets that the router relays verbatim
 RELAY_HEADERS = ("X-Query-Id", "X-Queue-Wait-Ms", "X-Cache",
                  "Retry-After", "X-SparkTpu-Replica",
-                 "X-SparkTpu-Trace-Id", "X-SparkTpu-Epoch")
+                 "X-SparkTpu-Trace-Id", "X-SparkTpu-Epoch",
+                 "X-SparkTpu-Predicted-Ms", "X-SparkTpu-Sched-Policy",
+                 "X-SparkTpu-Brownout")
 
 #: connection-level failures that mean "this replica is gone" — the
 #: re-dispatch trigger (same set the connect Client classifies as
@@ -619,6 +621,7 @@ class Federation:
         retry_afters: List[float] = []
         last_err: Optional[BaseException] = None
         shed = False
+        slo_reject = None  # last typed 503 (InfeasibleDeadline) seen
         # ownership routing: plan the query to the replica OWNING its
         # scans (rendezvous hash over healthy members) so the fleet
         # behaves as one coherent cache instead of N cold ones
@@ -732,6 +735,29 @@ class Federation:
                 if recovery.retry_allowed("serve.dispatch"):
                     continue
                 return code, data, hdr  # budget spent: surface typed
+            if code == 503:
+                # typed SLO reject (InfeasibleDeadline): the replica's
+                # latency model predicts the query cannot finish inside
+                # its deadline given THAT replica's backlog. The
+                # replica ANSWERED (breaker success), the fleet
+                # brownout records a shed, and the request is ABSORBED
+                # into a re-dispatch toward the least-loaded other
+                # replica while the unified retry budget allows — a
+                # different queue is a different prediction. Budget
+                # spent (or fleet exhausted), the typed 503 SURFACES
+                # with the prediction that condemned it.
+                r.breaker.success()
+                self.brownout.note("shed")
+                exhausted.add(r.id)
+                shed = True
+                slo_reject = (code, data, hdr)
+                metrics.note_serve("slo_rejects")
+                metrics.record(
+                    "serve", phase="slo_reject", replica=r.id,
+                    predicted_ms=hdr.get("X-SparkTpu-Predicted-Ms"))
+                if recovery.retry_allowed("serve.dispatch"):
+                    continue
+                return code, data, hdr  # budget spent: surface typed
             if code == 429:
                 # admission shedding: this replica's scheduler is
                 # full — take the request to the emptiest other queue.
@@ -755,6 +781,15 @@ class Federation:
             r.breaker.success()
             self.brownout.note("ok")
             return code, data, hdr
+        if slo_reject is not None:
+            # every candidate replica predicted the deadline
+            # infeasible (or the budget ran dry re-dispatching): the
+            # typed 503 surfaces with its prediction — more
+            # actionable than a synthesized 429, and never retried
+            # by the client on the same deadline
+            metrics.note_serve("rejected")
+            metrics.record("serve", phase="slo_reject_surfaced")
+            return slo_reject
         if retry_afters:
             # ALL healthy replicas saturated: now (and only now) the
             # client sees the 429; Retry-After is the soonest any

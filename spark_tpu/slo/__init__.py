@@ -1,0 +1,15 @@
+"""SLO-driven serving: predict -> schedule -> shed (ROADMAP item 5).
+
+Per-plan latency prediction (:mod:`spark_tpu.slo.model`), earliest-
+feasible-deadline-first ordering with typed reject-at-admission
+(:mod:`spark_tpu.slo.edf`), and the predictive brownout / auto-
+concurrency controller (:mod:`spark_tpu.slo.controller`). The whole
+subsystem is gated on ``spark.tpu.slo.enabled``; off, the scheduler's
+FIFO/FAIR paths are byte-identical to the pre-SLO engine.
+"""
+
+from spark_tpu.slo.edf import InfeasibleDeadline, edf_key  # noqa: F401
+from spark_tpu.slo.model import (LatencyModel,  # noqa: F401
+                                 fingerprint_plan, fingerprint_sql,
+                                 model_path_from_conf, plan_input_rows)
+from spark_tpu.slo.controller import SloController  # noqa: F401

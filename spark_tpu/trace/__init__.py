@@ -85,6 +85,8 @@ SPAN_NAMES = frozenset({
     "storage.pin",          # HBM pin-scope around query execution
     "join.partition",       # hybrid hash join: grant + partition pass
     "join.spill",           # hybrid hash join: one spill write/read
+    "slo.admit",            # SLO feasibility check at submit time
+    "slo.observe",          # fold a finished query into the SLO model
 })
 
 

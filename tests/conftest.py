@@ -114,6 +114,11 @@ def pytest_configure(config):
         "randomized multi-point fault schedules asserting "
         "byte-identical-or-typed-error, zero hangs, attempts within "
         "the unified retry budget")
+    config.addinivalue_line(
+        "markers",
+        "slo: SLO-driven serving (spark_tpu/slo/) — per-plan latency "
+        "prediction, EDF scheduling, reject-at-admission, predictive "
+        "brownout, on/off byte-identity")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -124,7 +129,8 @@ def pytest_collection_modifyitems(config, items):
         if ("compile" in item.keywords or "serve" in item.keywords
                 or "mview" in item.keywords or "agg" in item.keywords
                 or "trace" in item.keywords
-                or "chaos" in item.keywords) \
+                or "chaos" in item.keywords
+                or "slo" in item.keywords) \
                 and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):

@@ -1,0 +1,561 @@
+"""SLO-driven serving (spark_tpu/slo/): per-plan latency prediction,
+earliest-feasible-deadline-first scheduling, reject-at-admission, and
+the predictive brownout / auto-concurrency controller.
+
+The hard invariants under test: SLO mode OFF leaves the scheduler's
+FIFO path byte-identical to the pre-SLO engine (device sweep {1,2,8});
+SLO mode ON sheds infeasible queries with the typed InfeasibleDeadline
+BEFORE they cost a queue slot, end-to-end client->router->replica; the
+latency model round-trips its journal so a restarted replica predicts
+from the first query; and saturation produces only typed outcomes,
+never hangs.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_tpu.expr.expressions as E
+import spark_tpu.plan.logical as L
+from spark_tpu import chaos, conf as CF, faults, locks, metrics, trace
+from spark_tpu.columnar.arrow import from_arrow
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.connect.server import Client
+from spark_tpu.parallel.executor import MeshExecutor
+from spark_tpu.parallel.mesh import make_mesh
+from spark_tpu.scheduler import QueryScheduler
+from spark_tpu.slo import (InfeasibleDeadline, LatencyModel,
+                           SloController, fingerprint_plan,
+                           fingerprint_sql, model_path_from_conf)
+from spark_tpu.slo.edf import backlog_ms, edf_key, feasible, pick_edf
+
+pytestmark = [pytest.mark.slo, pytest.mark.timeout(240)]
+
+
+def make_scheduler(**overrides):
+    return QueryScheduler(conf=RuntimeConf(overrides))
+
+
+def make_slo_scheduler(**overrides):
+    overrides.setdefault("spark.tpu.slo.enabled", True)
+    return make_scheduler(**overrides)
+
+
+def _train(sched, fp, run, n=3, **submit_kw):
+    """Run ``run`` n times under ``fp`` and wait until the latency
+    model can predict it (note_finished lands just after the ticket
+    resolves, so give the observation a bounded moment to arrive)."""
+    for _ in range(n):
+        sched.submit(run, slo_fp=fp, **submit_kw).result(30)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if sched._slo.model.predict_run_ms(fp) is not None:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"model never learned {fp}")
+
+
+# ---- registrations (lint satellites) ----------------------------------------
+
+
+def test_slo_registrations():
+    for key in ("spark.tpu.slo.enabled", "spark.tpu.slo.targetP99Ms",
+                "spark.tpu.slo.rejectEnabled",
+                "spark.tpu.slo.rejectMargin",
+                "spark.tpu.slo.model.alpha", "spark.tpu.slo.model.path",
+                "spark.tpu.slo.model.maxEntries",
+                "spark.tpu.slo.controller.windowSeconds",
+                "spark.tpu.slo.controller.minPredictions",
+                "spark.tpu.slo.controller.exitRatio",
+                "spark.tpu.slo.autoConcurrency.enabled",
+                "spark.tpu.slo.autoConcurrency.min"):
+        assert CF.is_registered(key), key
+    for point in ("slo.predict", "slo.reject"):
+        assert point in faults.POINTS
+        assert CF.is_registered(f"spark.tpu.faultInjection.{point}")
+    assert "slo.admit" in trace.SPAN_NAMES
+    assert "slo.observe" in trace.SPAN_NAMES
+    # both locks ranked INSIDE scheduler.cond (taken while it is held)
+    assert locks.LOCK_RANKS["slo.model"] > \
+        locks.LOCK_RANKS["scheduler.cond"]
+    assert locks.LOCK_RANKS["slo.controller"] > \
+        locks.LOCK_RANKS["scheduler.cond"]
+
+
+def test_infeasible_deadline_is_typed_for_chaos():
+    e = InfeasibleDeadline(500.0, time.time() + 0.1)
+    assert "INFEASIBLE_DEADLINE" in str(e)
+    assert chaos.is_typed_error(e)
+    # and through a cause chain, as the client surfaces it
+    try:
+        raise RuntimeError("wrapper") from e
+    except RuntimeError as outer:
+        assert chaos.is_typed_error(outer)
+
+
+# ---- EDF policy helpers (pure) ----------------------------------------------
+
+
+class _T:
+    def __init__(self, tid, deadline):
+        self.id = tid
+        self.deadline = deadline
+
+
+def test_edf_key_total_order():
+    now = time.time()
+    early, late = _T(5, now + 1), _T(1, now + 9)
+    none1, none2 = _T(2, None), _T(3, None)
+    assert edf_key(early) < edf_key(late)
+    # deadline-less tickets sort AFTER every deadlined one, FIFO among
+    # themselves
+    assert edf_key(late) < edf_key(none1)
+    assert edf_key(none1) < edf_key(none2)
+    assert pick_edf([none2, late, early, none1]) is early
+    assert pick_edf([]) is None
+
+
+def test_feasibility_math():
+    ok, pred = feasible(None, 100.0, 50.0)
+    assert ok and pred == 150.0
+    now = time.time()
+    ok, _ = feasible(now + 1.0, 100.0, 50.0, now=now)
+    assert ok
+    ok, pred = feasible(now + 0.1, 100.0, 50.0, now=now)
+    assert not ok and pred == 150.0
+    # margin scales the prediction, flipping marginal calls
+    ok, _ = feasible(now + 0.2, 100.0, 50.0, margin=2.0, now=now)
+    assert not ok
+    # unknown backlog entries fall back to the default estimate;
+    # in-flight queries count half
+    assert backlog_ms([None, 100.0], [], 1, 40.0) == 140.0
+    assert backlog_ms([], [100.0], 1, 40.0) == 50.0
+    assert backlog_ms([100.0, 100.0], [], 2, 40.0) == 100.0
+
+
+# ---- EDF vs FIFO A/B determinism --------------------------------------------
+
+
+def _ab_completion_order(slo_on):
+    sched = make_scheduler(**{
+        "spark.tpu.scheduler.maxConcurrency": 1,
+        "spark.tpu.slo.enabled": slo_on})
+    order = []
+    gate = threading.Event()
+    try:
+        blocker = sched.submit(lambda t: gate.wait(20),
+                               description="blocker")
+        deadline = time.time() + 10.0
+        while blocker.state != "RUNNING" and time.time() < deadline:
+            time.sleep(0.005)
+        assert blocker.state == "RUNNING"
+
+        def mk(name):
+            return lambda t: order.append(name)
+
+        # submitted in REVERSE deadline order: FIFO runs them as
+        # submitted, EDF reorders to earliest-deadline-first
+        tickets = [sched.submit(mk("late"), deadline_s=60.0),
+                   sched.submit(mk("mid"), deadline_s=40.0),
+                   sched.submit(mk("early"), deadline_s=20.0)]
+        gate.set()
+        blocker.result(30)
+        for t in tickets:
+            t.result(30)
+    finally:
+        gate.set()
+        sched.stop()
+    return order
+
+
+def test_edf_vs_fifo_ab_determinism():
+    assert _ab_completion_order(False) == ["late", "mid", "early"]
+    assert _ab_completion_order(True) == ["early", "mid", "late"]
+    # rerun: the A/B is deterministic, not a lucky interleaving
+    assert _ab_completion_order(True) == ["early", "mid", "late"]
+
+
+# ---- reject-at-admission ----------------------------------------------------
+
+
+def test_reject_at_admission_no_queue_slot():
+    sched = make_slo_scheduler()
+    fp = fingerprint_sql("SELECT slo_reject_test")
+    try:
+        _train(sched, fp, lambda t: time.sleep(0.05))
+        seq_before = sched._seq
+        with pytest.raises(InfeasibleDeadline) as ei:
+            sched.submit(lambda t: time.sleep(0.05), slo_fp=fp,
+                         deadline_s=0.0001)
+        # shed BEFORE existing: no ticket was minted, no queue slot
+        # consumed, and the error carries the condemning prediction
+        assert sched._seq == seq_before
+        assert sched.queue_depth() == 0
+        assert ei.value.predicted_ms > 0
+        assert "INFEASIBLE_DEADLINE" in str(ei.value)
+        assert metrics.slo_stats()["rejects"] >= 1
+    finally:
+        sched.stop()
+
+
+def test_reject_disabled_admits_doomed_query():
+    sched = make_slo_scheduler(
+        **{"spark.tpu.slo.rejectEnabled": False})
+    fp = fingerprint_sql("SELECT slo_noreject_test")
+    try:
+        _train(sched, fp, lambda t: time.sleep(0.05))
+        # the doomed query is admitted and dies LATE (deadline purge),
+        # exactly the pre-SLO behaviour the reject flag buys back
+        t = sched.submit(lambda t: time.sleep(0.05), slo_fp=fp,
+                         deadline_s=0.0001)
+        with pytest.raises(Exception) as ei:
+            t.result(30)
+        assert "DEADLINE_EXCEEDED" in str(ei.value)
+    finally:
+        sched.stop()
+
+
+def test_reject_fault_point_fails_open():
+    conf = {"spark.tpu.faultInjection.slo.reject": "nth:1"}
+    sched = make_slo_scheduler(**conf)
+    fp = fingerprint_sql("SELECT slo_failopen_test")
+    try:
+        _train(sched, fp, lambda t: time.sleep(0.05))
+        # the injected fault disables the reject gate for this submit:
+        # the doomed query is ADMITTED (fails open, dies LATE via the
+        # deadline purge) instead of being shed early — injection can
+        # only admit more, never reject spuriously
+        t = sched.submit(lambda t: None, slo_fp=fp, deadline_s=0.0001)
+        with pytest.raises(Exception) as ei:
+            t.result(30)
+        assert not isinstance(ei.value, InfeasibleDeadline)
+        assert "DEADLINE_EXCEEDED" in str(ei.value)
+    finally:
+        sched.stop()
+
+
+def test_predict_fault_point_degrades_to_no_prediction():
+    sched = make_slo_scheduler(
+        **{"spark.tpu.faultInjection.slo.predict": "prob:1.0:7"})
+    fp = fingerprint_sql("SELECT slo_predfault_test")
+    try:
+        for _ in range(3):
+            sched.submit(lambda t: time.sleep(0.01),
+                         slo_fp=fp).result(30)
+        # every prediction absorbed: even a trained fingerprint with a
+        # microscopic deadline is admitted (and then deadline-purged) —
+        # bytes never depend on the model
+        t = sched.submit(lambda t: None, slo_fp=fp, deadline_s=0.0001)
+        with pytest.raises(Exception) as ei:
+            t.result(30)
+        assert chaos.is_typed_error(ei.value)
+        assert not isinstance(ei.value, InfeasibleDeadline)
+    finally:
+        sched.stop()
+
+
+# ---- typed error across client -> router -> replica -------------------------
+
+
+@pytest.fixture
+def slo_fleet(spark):
+    from spark_tpu.serve.router import serve_fleet
+
+    spark.conf.set("spark.tpu.slo.enabled", "true")
+    spark.conf.set("spark.tpu.slo.targetP99Ms", "5000")
+    fl = serve_fleet(spark, replicas=1)
+    try:
+        yield fl
+    finally:
+        fl.stop()
+        for k in ("spark.tpu.slo.enabled", "spark.tpu.slo.targetP99Ms"):
+            if k in spark.conf._overrides:
+                spark.conf.unset(k)
+        metrics.set_brownout(0)
+        metrics.reset_slo()
+
+
+def test_infeasible_deadline_client_router_replica(spark, slo_fleet):
+    tbl = pa.table({"a": list(range(64)),
+                    "b": [float(i) for i in range(64)]})
+    spark.createDataFrame(tbl).createOrReplaceTempView("slo_e2e")
+    c = Client(slo_fleet.url, timeout=30.0, retries=2)
+    sql = "SELECT a, b FROM slo_e2e WHERE a >= 8"
+    for _ in range(3):
+        c.sql(sql)
+    # the success path surfaces the SLO outcome on last_query
+    lq = c.last_query
+    assert lq["sched_policy"] == "EDF"
+    assert lq["slo_rejected"] is False
+    assert lq["slo_actual_ms"] > 0
+    assert lq["brownout"] in ("0", "1")
+    # now the same (trained) plan with a microscopic deadline: the
+    # replica 503s typed, the router absorbs it into re-dispatch until
+    # the fleet/budget is exhausted, then SURFACES it typed, and the
+    # client raises InfeasibleDeadline without retrying
+    with pytest.raises(InfeasibleDeadline) as ei:
+        c.sql(sql, deadline_s=0.0005)
+    assert ei.value.predicted_ms > 0
+    assert c.last_query["slo_rejected"] is True
+    assert c.last_query["slo_predicted_ms"] == pytest.approx(
+        ei.value.predicted_ms, rel=1e-3)
+    assert metrics.serve_stats().get("slo_rejects", 0) >= 1
+    # a shed is early by construction: the reject round-trip costs
+    # far less than the work it refused to queue
+    assert c.last_query["slo_actual_ms"] < 5_000
+
+
+# ---- latency model: cold start + persistence --------------------------------
+
+
+def test_model_cold_start_and_persistence_roundtrip(tmp_path):
+    path = str(tmp_path / "slo_model.jsonl")
+    m1 = LatencyModel(path, alpha=0.5, max_entries=64)
+    fp = fingerprint_sql("SELECT persistence_test")
+    assert m1.predict_run_ms(fp) is None  # cold start: no prediction
+    m1.observe(fp, run_ms=100.0, queue_ms=10.0, rows=1000.0)
+    m1.observe(fp, run_ms=50.0, queue_ms=20.0, rows=1000.0)
+    pred1 = m1.predict_run_ms(fp)
+    assert pred1 is not None and 50.0 <= pred1 <= 100.0
+    # a "restarted replica": a fresh model over the same journal
+    # predicts from the first query
+    m2 = LatencyModel(path, alpha=0.5, max_entries=64)
+    assert m2.predict_run_ms(fp) == pytest.approx(pred1)
+    assert m2.predict_queue_ms(fp) == pytest.approx(
+        m1.predict_queue_ms(fp))
+
+
+def test_model_rowcount_scaling():
+    m = LatencyModel("")  # in-memory
+    fp = "sql:" + "a" * 24
+    for _ in range(4):
+        m.observe(fp, run_ms=100.0, rows=1000.0, device_ms=80.0,
+                  transfer_ms=0.0)
+    base = m.predict_run_ms(fp, rows=1000.0)
+    double = m.predict_run_ms(fp, rows=2000.0)
+    half = m.predict_run_ms(fp, rows=500.0)
+    # device share scales with input rows, host share does not
+    assert half < base < double
+    # the ratio is clamped: a wild cardinality estimate cannot produce
+    # an absurd prediction
+    wild = m.predict_run_ms(fp, rows=10_000_000.0)
+    assert wild <= m.predict_run_ms(fp, rows=10_000.0)
+
+
+def test_model_journal_compaction_bound(tmp_path):
+    path = str(tmp_path / "compact.jsonl")
+    m = LatencyModel(path, max_entries=8)
+    for i in range(40):
+        m.observe(f"sql:{i:024d}", run_ms=float(i + 1))
+    with open(path) as f:
+        lines = [ln for ln in f if ln.strip()]
+    # compaction keeps the journal bounded near 2x maxEntries
+    assert len(lines) <= 2 * 8
+    # LRU bound: only the newest maxEntries fingerprints survive
+    m2 = LatencyModel(path, max_entries=8)
+    assert m2.snapshot()["entries"] <= 8
+    assert m2.predict_run_ms("sql:" + f"{39:024d}") is not None
+
+
+def test_model_path_beside_history_journal(tmp_path):
+    conf = RuntimeConf({"spark.tpu.compile.store.dir": str(tmp_path)})
+    assert model_path_from_conf(conf) == os.path.join(
+        str(tmp_path), "slo_model.jsonl")
+    conf2 = RuntimeConf({"spark.tpu.slo.model.path":
+                         str(tmp_path / "explicit.jsonl")})
+    assert model_path_from_conf(conf2).endswith("explicit.jsonl")
+    assert model_path_from_conf(RuntimeConf()) == ""
+
+
+def test_model_tolerates_torn_journal(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    m = LatencyModel(path)
+    fp = "sql:" + "b" * 24
+    m.observe(fp, run_ms=42.0)
+    with open(path, "a") as f:
+        f.write('{"fp": "sql:garbage", "run_ms"\n')  # torn tail line
+    m2 = LatencyModel(path)
+    assert m2.predict_run_ms(fp) == pytest.approx(42.0)
+
+
+# ---- on/off byte-identity sweep ---------------------------------------------
+
+
+_MESHES = {}
+
+
+def _mesh(d):
+    if d not in _MESHES:
+        _MESHES[d] = make_mesh(d)
+    return _MESHES[d]
+
+
+def _sweep_plan(rng):
+    keys = rng.integers(0, 50, 2000)
+    rel = L.Relation(from_arrow(pa.table({
+        "k": pa.array(np.asarray(keys, np.int64), pa.int64()),
+        "v": pa.array(np.asarray(rng.integers(0, 1000, 2000),
+                                 np.int64), pa.int64())})))
+    v = E.Col("v")
+    return L.Sort((E.SortOrder(E.Col("k")),), L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Sum(v), "s"), E.Alias(E.Count(v), "n")),
+        rel))
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_on_off_byte_identity_sweep(devices, rng):
+    """The tentpole invariant: the same plan through the scheduler
+    produces identical rows with SLO off and on, per device count —
+    EDF/prediction may reorder and shed, but it never changes bytes."""
+    plan = _sweep_plan(rng)
+    ex = MeshExecutor(_mesh(devices), conf=RuntimeConf())
+
+    def run_through(slo_on):
+        sched = make_scheduler(**{"spark.tpu.slo.enabled": slo_on})
+        try:
+            assert (sched._slo is not None) == slo_on
+            t = sched.submit(
+                lambda t: ex.execute_logical(plan),
+                slo_fp=fingerprint_sql("byte identity sweep")
+                if slo_on else None,
+                deadline_s=120.0 if slo_on else None)
+            return [tuple(d.values())
+                    for d in t.result(120).to_pylist()]
+        finally:
+            sched.stop()
+
+    assert run_through(True) == run_through(False), devices
+
+
+# ---- predictive brownout + auto-concurrency ---------------------------------
+
+
+def _controller(**overrides):
+    conf = RuntimeConf({"spark.tpu.slo.enabled": True, **overrides})
+    return SloController(conf, LatencyModel(""), max_concurrency=4)
+
+
+def test_predictive_brownout_enter_exit():
+    ctl = _controller(**{
+        "spark.tpu.slo.targetP99Ms": 100.0,
+        "spark.tpu.slo.controller.windowSeconds": 1.0,
+        "spark.tpu.slo.controller.minPredictions": 3,
+        "spark.tpu.slo.controller.exitRatio": 0.8})
+    try:
+        assert ctl.brownout_level() == 0
+        for _ in range(4):  # predicted completions far past target
+            ctl.admission_check_locked(
+                deadline=None, pred_run_ms=500.0, pending_ms=[],
+                inflight_ms=[], reject=False)
+        assert ctl.brownout_level() == 1
+        assert metrics.brownout_level() == 1
+        assert metrics.slo_stats()["brownout_enters"] >= 1
+        # predictions recover; once the hot window ages out, the p99
+        # falls under exitRatio x target and the brownout EXITS
+        time.sleep(1.1)
+        for _ in range(4):
+            ctl.admission_check_locked(
+                deadline=None, pred_run_ms=10.0, pending_ms=[],
+                inflight_ms=[], reject=False)
+        assert ctl.brownout_level() == 0
+        assert metrics.brownout_level() == 0
+        assert metrics.slo_stats()["brownout_exits"] >= 1
+    finally:
+        metrics.set_brownout(0)
+
+
+def test_auto_concurrency_resize():
+    ctl = _controller(**{
+        "spark.tpu.slo.controller.minPredictions": 1,
+        "spark.tpu.slo.autoConcurrency.min": 1})
+    assert ctl.effective_concurrency() == 4
+    # queueing dominates run time -> shrink toward the floor
+    for _ in range(8):
+        ctl._last_resize = 0.0  # bypass the resize cooldown
+        ctl._note_ratios(queue_ms=1000.0, run_ms=10.0)
+    assert ctl.effective_concurrency() < 4
+    shrunk = ctl.effective_concurrency()
+    # queues drain -> grow back toward the configured maximum
+    for _ in range(32):
+        ctl._last_resize = 0.0
+        ctl._note_ratios(queue_ms=1.0, run_ms=100.0)
+    assert ctl.effective_concurrency() > shrunk
+    assert ctl.effective_concurrency() <= 4
+    assert metrics.slo_stats()["resizes"] >= 2
+
+
+def test_effective_concurrency_bounds_parallel_runs():
+    sched = make_slo_scheduler(
+        **{"spark.tpu.scheduler.maxConcurrency": 4})
+    try:
+        # force the controller's auto-sized limit down to 1
+        with sched._slo._lock:
+            sched._slo._effective = 1
+        peak = [0]
+        active = [0]
+        lk = threading.Lock()
+
+        def work(t):
+            with lk:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.05)
+            with lk:
+                active[0] -= 1
+
+        tickets = [sched.submit(work) for _ in range(4)]
+        for t in tickets:
+            t.result(30)
+        assert peak[0] == 1  # EDF pick honored the auto-sized limit
+    finally:
+        sched.stop()
+
+
+# ---- overload smoke (tier-1) ------------------------------------------------
+
+
+def test_overload_typed_outcomes_only():
+    """Saturating a tiny SLO scheduler with a deadline mix produces
+    ONLY successes or typed errors (reject / queue-full / deadline),
+    never an untyped crash — and the shed-early path engages."""
+    sched = make_slo_scheduler(**{
+        "spark.tpu.scheduler.maxConcurrency": 2,
+        "spark.tpu.scheduler.queueDepth": 4})
+    fp = fingerprint_sql("SELECT overload_smoke")
+    outcomes = []
+    lock = threading.Lock()
+    try:
+        _train(sched, fp, lambda t: time.sleep(0.02))
+
+        def client(i):
+            # mixed deadlines: some comfortable, some doomed
+            dl = 10.0 if i % 3 else 0.003
+            try:
+                t = sched.submit(lambda t: time.sleep(0.02),
+                                 slo_fp=fp, deadline_s=dl)
+                t.result(30)
+                with lock:
+                    outcomes.append(("ok", None))
+            except BaseException as e:  # noqa: BLE001 — classified below
+                with lock:
+                    outcomes.append(("err", e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(24)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+        assert not any(th.is_alive() for th in threads), "hung client"
+    finally:
+        sched.stop()
+    assert len(outcomes) == 24
+    bad = [e for kind, e in outcomes
+           if kind == "err" and not chaos.is_typed_error(e)]
+    assert not bad, f"untyped under overload: {bad!r}"
+    assert any(kind == "ok" for kind, _ in outcomes)

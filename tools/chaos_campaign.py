@@ -29,6 +29,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -475,6 +476,213 @@ def _fleet_scenarios(session, fleet, args) -> bool:
     return ok
 
 
+# -- overload family (--family overload): SLO predict -> schedule -> shed --
+
+
+#: set BEFORE serve_fleet — the scheduler reads worker count and
+#: constructs its SloController at build time. A deliberately small
+#: fleet (2 workers, 16-deep queue per replica) so the saturation
+#: scenarios reach genuine 2x overload with a handful of threads.
+_OVERLOAD_CONF = {
+    "spark.tpu.slo.enabled": True,
+    "spark.tpu.scheduler.maxConcurrency": 2,
+    "spark.tpu.scheduler.queueDepth": 16,
+    "spark.tpu.slo.controller.windowSeconds": 2.0,
+    "spark.tpu.slo.controller.minPredictions": 5,
+}
+
+
+def _live(fleet):
+    return [s for s in fleet.replicas
+            if getattr(s, "_thread", None) is not None]
+
+
+def _train_fleet(fleet, args, n: int = 4) -> None:
+    """Warm every replica's latency model DIRECTLY (the router would
+    concentrate training on whichever replica won affinity) and wait
+    until each one predicts the scan query's fingerprint."""
+    from spark_tpu.slo.model import fingerprint_sql
+
+    fp = fingerprint_sql(_QUERIES[0])
+    for s in _live(fleet):
+        c = Client(s.url, timeout=args.timeout, retries=3)
+        for q in _QUERIES:
+            for _ in range(n):
+                c.sql(q)
+        deadline_t = time.time() + 10.0
+        while s.scheduler._slo.model.predict_run_ms(fp) is None \
+                and time.time() < deadline_t:
+            time.sleep(0.02)
+        assert s.scheduler._slo.model.predict_run_ms(fp) is not None, \
+            f"model never trained on replica {s.replica_id}"
+
+
+def _overload_saturation(session, fleet, args) -> bool:
+    """Sustained ~2x saturation with comfortable deadlines: every
+    outcome is a success or a typed error, no client thread hangs, and
+    the fleet keeps serving (some successes) the whole time."""
+    fed = fleet.router.federation
+    fed.probe(force=True)
+    for r in fed.replicas:
+        r.breaker.reset()
+    _clear_caches(session, fleet)
+    _train_fleet(fleet, args)
+    outcomes = []
+    lock = threading.Lock()
+
+    def worker(i):
+        c = Client(fleet.url, timeout=args.timeout, retries=2)
+        for j in range(3):
+            try:
+                c.sql(_QUERIES[(i + j) % len(_QUERIES)],
+                      deadline_s=args.timeout)
+                with lock:
+                    outcomes.append(("ok", None))
+            except Exception as e:  # classified below
+                with lock:
+                    outcomes.append(("err", e))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(16)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(args.alarm)
+    elapsed = time.time() - t0
+    hung = sum(1 for t in threads if t.is_alive())
+    untyped = [e for k, e in outcomes
+               if k == "err" and not chaos.is_typed_error(e)]
+    n_ok = sum(1 for k, _ in outcomes if k == "ok")
+    ok = (hung == 0 and not untyped and n_ok > 0
+          and len(outcomes) == 16 * 3)
+    print(f"overload-saturation: {len(outcomes)} outcomes "
+          f"({n_ok} ok, {len(outcomes) - n_ok} typed) in "
+          f"{elapsed:.1f}s, hung={hung}, untyped={len(untyped)} "
+          f"-> {'ok' if ok else 'FAIL'}")
+    for e in untyped[:3]:
+        print(f"  untyped: {e!r}")
+    return ok
+
+
+def _overload_deadline_mix(session, fleet, args) -> bool:
+    """Doomed deadlines shed EARLY with the typed InfeasibleDeadline
+    (the reject round-trip costs milliseconds, never the deadline or a
+    queue slot); interleaved loose deadlines keep succeeding through
+    the same fleet. deadline_s is relative and converted at the
+    replica, so the check is deterministic once the model is warm."""
+    from spark_tpu.slo.edf import InfeasibleDeadline
+
+    _clear_caches(session, fleet)
+    _train_fleet(fleet, args)
+    rejects0 = metrics.slo_stats()["rejects"]
+    c = Client(fleet.url, timeout=args.timeout, retries=2)
+    shed_ms, wrong = [], []
+    for i in range(12):
+        tight = i % 2 == 0
+        t0 = time.time()
+        try:
+            c.sql(_QUERIES[0],
+                  deadline_s=0.0005 if tight else args.timeout)
+            if tight:
+                wrong.append(f"tight #{i} was served")
+        except InfeasibleDeadline:
+            if tight:
+                shed_ms.append((time.time() - t0) * 1e3)
+            else:
+                wrong.append(f"loose #{i} rejected")
+        except Exception as e:
+            wrong.append(f"#{i} ({'tight' if tight else 'loose'}): "
+                         f"{e!r}")
+    rejected = metrics.slo_stats()["rejects"] - rejects0
+    worst = max(shed_ms) if shed_ms else float("inf")
+    ok = not wrong and rejected >= 6 and worst < 2000.0
+    print(f"overload-deadline-mix: {len(shed_ms)}/6 tight shed typed "
+          f"(worst round-trip {worst:.1f}ms), {rejected} admission "
+          f"rejects, {len(wrong)} wrong -> {'ok' if ok else 'FAIL'}")
+    for w in wrong[:4]:
+        print(f"  wrong: {w}")
+    return ok
+
+
+def _overload_brownout_flap(session, fleet, args) -> bool:
+    """Predictive brownout ENTERS under saturation (predicted p99
+    blows past the target while queries are merely queued, not yet
+    late) and EXITS once the queues drain — level back to 0, no flap
+    residue. Targets are pinned per-controller to 3x that replica's
+    own trained run prediction so the scenario measures QUEUEING, not
+    absolute machine speed."""
+    from spark_tpu.slo.model import fingerprint_sql
+
+    _clear_caches(session, fleet)
+    _train_fleet(fleet, args)
+    fp = fingerprint_sql(_QUERIES[0])
+    stats0 = metrics.slo_stats()
+    saved = {}
+    ctls = {s.replica_id: (s, s.scheduler._slo) for s in _live(fleet)}
+    for rid, (s, ctl) in ctls.items():
+        pred = ctl.model.predict_run_ms(fp) or 10.0
+        with ctl._lock:
+            saved[rid] = ctl._target_ms
+            ctl._target_ms = 3.0 * pred
+    try:
+        def burst(i):
+            c = Client(fleet.url, timeout=args.timeout, retries=2)
+            for _ in range(2):
+                try:
+                    c.sql(_QUERIES[0], deadline_s=args.timeout)
+                except Exception:
+                    pass  # typed shedding under burst is fine here
+
+        threads = [threading.Thread(target=burst, args=(i,),
+                                    daemon=True) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(args.alarm)
+        entered = [rid for rid, (s, ctl) in ctls.items()
+                   if ctl.brownout_level() == 1]
+        if not entered:
+            print("overload-brownout-flap: FAIL (no controller "
+                  "entered brownout under 24-thread burst)")
+            return False
+        # drain, then trickle light load at the browned-out replicas:
+        # predictions fall back to bare run time, the hot window ages
+        # out, and the controller exits with hysteresis
+        time.sleep(2.2)
+        deadline_t = time.time() + 20.0
+        while time.time() < deadline_t and any(
+                ctls[rid][1].brownout_level() == 1 for rid in entered):
+            for rid in entered:
+                s, ctl = ctls[rid]
+                if ctl.brownout_level() == 1:
+                    Client(s.url, timeout=args.timeout,
+                           retries=2).sql(_QUERIES[0])
+            time.sleep(0.25)
+        still = [rid for rid in entered
+                 if ctls[rid][1].brownout_level() == 1]
+        stats = metrics.slo_stats()
+        ok = (not still
+              and stats["brownout_enters"] > stats0["brownout_enters"]
+              and stats["brownout_exits"] > stats0["brownout_exits"])
+        print(f"overload-brownout-flap: entered on {entered}, "
+              f"exits={stats['brownout_exits'] - stats0['brownout_exits']}, "
+              f"stuck={still} -> {'ok' if ok else 'FAIL'}")
+        return ok
+    finally:
+        for rid, (s, ctl) in ctls.items():
+            with ctl._lock:
+                ctl._target_ms = saved[rid]
+        metrics.set_brownout(0)
+
+
+def _overload_scenarios(session, fleet, args) -> bool:
+    ok = _overload_saturation(session, fleet, args)
+    ok = _overload_deadline_mix(session, fleet, args) and ok
+    ok = _overload_brownout_flap(session, fleet, args) and ok
+    return ok
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -493,13 +701,17 @@ def main(argv=None) -> int:
                     help="re-run one failing schedule from artifact")
     ap.add_argument("--skip-scenarios", action="store_true",
                     help="random sweep only (no directed scenarios)")
-    ap.add_argument("--family", choices=("core", "fleet"),
+    ap.add_argument("--family", choices=("core", "fleet", "overload"),
                     default="core",
                     help="core = policy-routed fleet + kill-revive/AB "
                          "scenarios; fleet = ownership mode (epochs, "
                          "owner routing, coherent caches) + "
                          "kill-owner / kill-and-revive-owner / "
-                         "partition / stale-read scenarios")
+                         "partition / stale-read scenarios; overload "
+                         "= SLO mode on a deliberately small fleet + "
+                         "sustained-saturation / deadline-mix / "
+                         "brownout-flap scenarios (shed early, never "
+                         "hang)")
     args = ap.parse_args(argv)
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -508,6 +720,9 @@ def main(argv=None) -> int:
             session.conf.set("spark.tpu.serve.ownership.enabled", True)
             session.conf.set("spark.tpu.serve.resultCache.enabled",
                              True)
+        elif args.family == "overload":
+            for k, v in _OVERLOAD_CONF.items():
+                session.conf.set(k, v)
         fleet = serve_fleet(session, replicas=args.replicas)
         try:
             if args.replay:
@@ -517,6 +732,10 @@ def main(argv=None) -> int:
                 if not args.skip_scenarios \
                         and args.family == "fleet":
                     ok = _fleet_scenarios(session, fleet, args) and ok
+                elif not args.skip_scenarios \
+                        and args.family == "overload":
+                    ok = _overload_scenarios(session, fleet,
+                                             args) and ok
                 elif not args.skip_scenarios:
                     ok = _kill_revive(session, fleet, args) and ok
                     ok = _ab_attempts(session, fleet, args) and ok
