@@ -109,7 +109,7 @@ def _query_deadline(extra_s: float = 0.0, cap_s: float = None) -> float:
 PHASE_BUDGET_S = {
     "cached": 180.0, "adaptive": 240.0, "serving": 240.0,
     "serve": 240.0, "fleet": 240.0, "mview": 180.0, "agg": 420.0,
-    "join": 420.0, "trace": 150.0, "slo": 300.0,
+    "join": 420.0, "trace": 150.0, "slo": 300.0, "fusion": 240.0,
 }
 
 
@@ -179,6 +179,13 @@ JOIN_MODE = os.environ.get("BENCH_JOIN", "1") == "1"
 # + the host/device/queue/transfer breakdown of one traced q3 land
 # under 'trace' in the result JSON)
 TRACE_MODE = os.environ.get("BENCH_TRACE", "1") == "1"
+
+# BENCH_FUSION=0 skips the whole-query fusion A/B (q3/q5-shaped
+# multi-exchange plans timed staged vs fused under adaptive execution;
+# total latency, host/queue trace breakdown before/after, fused span
+# counts and byte-identity land under 'fusion' in the result JSON;
+# needs BENCH_MASTER=mesh[N] to engage)
+FUSION_MODE = os.environ.get("BENCH_FUSION", "1") == "1"
 
 # BENCH_FLEET=0 skips the fleet scaling sweep (QPS vs replica count on
 # NON-cacheable unique-plan traffic over a sharded dataset with
@@ -1327,6 +1334,23 @@ def main():
                 trace_ab = {"error": f"{type(e).__name__}: {e}"}
         _phase_snapshot(trace=trace_ab)
 
+    fusion_ab = None
+    if FUSION_MODE:
+        if _wall_remaining() <= 5:
+            fusion_ab = _budget_skip("fusion")
+        else:
+            print("[bench] fusion A/B: multi-exchange plans staged vs "
+                  "fused (spark.tpu.fusion.enabled off vs on)",
+                  file=sys.stderr, flush=True)
+            try:
+                with _deadline(_phase_deadline("fusion")):
+                    fusion_ab = _run_fusion_ab(spark)
+            except _QueryTimeout:
+                fusion_ab = {"error": "timeout"}
+            except Exception as e:
+                fusion_ab = {"error": f"{type(e).__name__}: {e}"}
+        _phase_snapshot(fusion=fusion_ab)
+
     # totals cover the queries that finished; failed/timed-out ones are
     # reported per-query and excluded so the JSON stays valid and the
     # headline number stays meaningful (flagged via queries_failed)
@@ -1368,6 +1392,7 @@ def main():
         **({"agg": agg_ab} if agg_ab is not None else {}),
         **({"join": join_ab} if join_ab is not None else {}),
         **({"trace": trace_ab} if trace_ab is not None else {}),
+        **({"fusion": fusion_ab} if fusion_ab is not None else {}),
         **({"analysis": analysis_overhead}
            if analysis_overhead is not None else {}),
         **({"all22_ms": {str(k): v for k, v in full.items()}}
@@ -1775,6 +1800,119 @@ def _run_trace_ab(spark) -> dict:
     finally:
         conf.unset("spark.tpu.trace.enabled")
         conf.unset("spark.tpu.trace.sampleRatio")
+    return out
+
+
+def _run_fusion_ab(spark) -> dict:
+    """Whole-query fusion A/B: the two multi-exchange shapes the fused
+    span targets — a float-sum group-by under a global sort (the q5
+    tail: the agg strategy is PINNED by legality, so the capacity
+    decision is the only adaptive decision and both exchange+consumer
+    pairs fuse) and the same tail behind a join (the q3 shape: the
+    broadcast switch stays a host decision and records its bailout,
+    the post-join pairs still fuse) — timed with adaptive execution on
+    and ``spark.tpu.fusion.enabled`` off (staged: one stats fetch +
+    re-trace per exchange) then on (one XLA program, decision on
+    device). Workloads are synthesized float columns rather than
+    TPC-H SQL because the TPC-H money columns are DECIMAL(12,2) —
+    exact int64 aggregates whose strategy crossover is live, which
+    correctly bails the whole plan to staged (``agg_strategy``). The
+    JSON records total latency AND the trace host/queue components
+    before/after: fusion's claim is specifically that inter-stage host
+    time goes to ~0 while bytes stay identical. Skipped on
+    single-device sessions (run with BENCH_MASTER=mesh[N] to engage)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import metrics, tracing
+
+    if getattr(spark, "_mesh", None) is None:
+        return {"skipped": "single-device session (no mesh): no "
+                           "exchange stages to fuse"}
+    rng = np.random.default_rng(11)
+    n = int(os.environ.get("BENCH_FUSION_ROWS", "400000"))
+    spark.createDataFrame(pa.table({
+        "k": pa.array(rng.integers(0, 4000, n), pa.int64()),
+        "f": pa.array(rng.random(n) * 100.0, pa.float64()),
+    })).createOrReplaceTempView("fusion_fact")
+    spark.createDataFrame(pa.table({
+        "k2": pa.array(np.arange(4000, dtype=np.int64), pa.int64()),
+        "w": pa.array(rng.random(4000), pa.float64()),
+    })).createOrReplaceTempView("fusion_dim")
+    small = int(os.environ.get("BENCH_FUSION_SMALL_ROWS", "4000"))
+    spark.createDataFrame(pa.table({
+        "k": pa.array(rng.integers(0, 400, small), pa.int64()),
+        "f": pa.array(rng.random(small) * 100.0, pa.float64()),
+    })).createOrReplaceTempView("fusion_fact_small")
+    queries = {
+        "groupby_sort": "SELECT k, sum(f) AS s FROM fusion_fact "
+                        "GROUP BY k ORDER BY k",
+        "join_groupby_sort": "SELECT k, sum(f) AS s "
+                             "FROM fusion_fact, fusion_dim "
+                             "WHERE k = k2 GROUP BY k ORDER BY k",
+        # the dispatch-bound regime: per-stage fixed costs (program
+        # launches, stats readbacks) dominate tiny inputs, which is
+        # where collapsing k stages into one program pays most
+        "groupby_sort_small": "SELECT k, sum(f) AS s "
+                              "FROM fusion_fact_small "
+                              "GROUP BY k ORDER BY k",
+    }
+    out = {"rows": n, "rows_small": small}
+    conf = spark.conf
+    conf.set("spark.tpu.adaptive.enabled", True)
+    try:
+        for name, sql in queries.items():
+            df = spark.sql(sql)
+
+            def timed(fused):
+                conf.set("spark.tpu.fusion.enabled", fused)
+                df.toArrow()  # warm-up: compile off the clock
+                got, runs = None, []
+                for _ in range(3):
+                    metrics.reset_fusion()  # stats reflect one run
+                    metrics.query_start(f"bench-fusion-{name}")
+                    t0 = time.perf_counter()
+                    got = df.toArrow()
+                    runs.append((time.perf_counter() - t0) * 1000.0)
+                evs = metrics.last_query()
+                bd = tracing.trace_breakdown(evs)
+                # the inter-stage host syncs fusion exists to remove:
+                # each exchange.stats span is a stats stage dispatch +
+                # D-integer readback + host decision between stages
+                syncs = [e for e in evs if e.get("kind") == "span"
+                         and e.get("name") == "exchange.stats"]
+                bd["stats_syncs"] = len(syncs)
+                bd["stats_sync_ms"] = round(
+                    sum(float(e.get("ms", 0.0)) for e in syncs), 3)
+                return (got, round(sorted(runs)[1], 1), bd,
+                        metrics.fusion_stats())
+
+            off_tbl, off_ms, off_bd, _ = timed(False)
+            on_tbl, on_ms, on_bd, st = timed(True)
+            out[name] = {
+                "staged_ms": off_ms,
+                "fused_ms": on_ms,
+                "speedup": round(off_ms / on_ms, 2) if on_ms else 0.0,
+                "byte_identical": bool(on_tbl.equals(off_tbl)),
+                "trace_breakdown": {
+                    "host_ms_staged": off_bd.get("host_ms"),
+                    "host_ms_fused": on_bd.get("host_ms"),
+                    "queue_ms_staged": off_bd.get("queue_ms"),
+                    "queue_ms_fused": on_bd.get("queue_ms"),
+                    "device_ms_staged": off_bd.get("device_ms"),
+                    "device_ms_fused": on_bd.get("device_ms"),
+                    "stats_syncs_staged": off_bd.get("stats_syncs"),
+                    "stats_syncs_fused": on_bd.get("stats_syncs"),
+                    "stats_sync_ms_staged": off_bd.get("stats_sync_ms"),
+                    "stats_sync_ms_fused": on_bd.get("stats_sync_ms"),
+                },
+                "fused_programs": st.get("fused_programs", 0),
+                "fused_spans": st.get("fused_spans", 0),
+                "bailouts": st.get("bailouts", 0),
+            }
+    finally:
+        conf.unset("spark.tpu.adaptive.enabled")
+        conf.unset("spark.tpu.fusion.enabled")
     return out
 
 

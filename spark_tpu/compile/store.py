@@ -175,7 +175,15 @@ def stable_plan_fingerprint(tier: str, plan, args, *, mesh_size: int = 1,
                             platform: Optional[str] = None,
                             extra: Any = None) -> str:
     """Hex digest identifying one stage executable across sessions and
-    processes: stable plan structure + argument avals + environment."""
+    processes: stable plan structure + argument avals + environment.
+
+    ``extra`` carries tier-specific compilation parameters that live
+    outside the plan tree: the ``fused_span`` tier (whole-query
+    fusion) passes one ``("ladder", bucket, variants)`` tuple per
+    fused span, so executables whose lax.switch branch set differs —
+    a changed ``spark.tpu.adaptive.capacityBucket`` or
+    ``spark.tpu.fusion.maxBucketVariants`` — never replay each
+    other's binaries, while prewarm replays exact matches."""
     payload = (tier, stable_plan_key(plan), _args_signature(args),
                environment_fingerprint(mesh_size, platform),
                _canon(extra))

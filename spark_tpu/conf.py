@@ -359,6 +359,35 @@ ADAPTIVE_AGG_CM_WIDTH = register(
     "exchange with slack. Rides the existing stats fetch as depth "
     "extra O(width) int vectors, psum-merged across the mesh.", int)
 
+# ---- whole-query native fusion ---------------------------------------------
+
+FUSION_ENABLED = register(
+    "spark.tpu.fusion.enabled", False,
+    "Whole-query native fusion (only active when "
+    "spark.tpu.adaptive.enabled is also on): adaptive exchange + "
+    "consumer pairs whose ONLY host dependency is the stats fetch "
+    "(capacity compaction) compile into ONE XLA program — the psum/"
+    "pmax stats stay on device and a lax.switch over a precompiled "
+    "capacity-bucket ladder replaces the host round-trip, so a multi-"
+    "exchange plan runs end-to-end with zero inter-stage host sync "
+    "(the Flare thesis, arXiv 1703.08219, XLA-native). Decisions that "
+    "genuinely need the host — broadcast-join switching on measured "
+    "bytes, skew fan/pre-split, the agg strategy crossover, sort "
+    "elision, the OOM ladder — bail out to staged execution with a "
+    "typed fusion_bailout event. Results are byte-identical on or "
+    "off.", bool)
+
+FUSION_MAX_BUCKET_VARIANTS = register(
+    "spark.tpu.fusion.maxBucketVariants", 4,
+    "Number of capacity-ladder branches baked into one fused program: "
+    "consumer capacities start at spark.tpu.adaptive.capacityBucket "
+    "and grow geometrically (x4) up to the static worst case, at most "
+    "this many rungs (the last rung is always the worst case, so any "
+    "measured count is covered). More variants track the staged "
+    "path's measured capacity tighter; fewer keep the fused program "
+    "small. Part of the compile-store fingerprint — changing it "
+    "recompiles fused spans.", int)
+
 SEARCHSORTED_SORT_THRESHOLD = register(
     "spark.tpu.kernels.searchsortedSortThreshold", 50,
     "physical/kernels.searchsorted picks XLA's O((n+m)log(n+m)) "

@@ -76,6 +76,16 @@ Injection points (key = ``spark.tpu.faultInjection.<point>``):
                          static partial->final strategy — like
                          ``agg.strategy``, the candidate list is pure
                          advice and is discarded whole on failure
+- ``fusion.decide``      the whole-query fusion decision
+                         (parallel/executor.py _try_fuse), fired after
+                         the plan is judged fusible but before the
+                         fused span is built: ANY kind
+                         (transient/oom/hang/corrupt) degrades to
+                         staged adaptive execution — the fused program
+                         is pure plan rewriting, the staged path
+                         computes the identical bytes, so injection
+                         can only cost the host round-trips fusion
+                         would have saved
 - ``slo.predict``        the SLO latency-model prediction at submit
                          time (slo/controller.py, OUTSIDE the
                          scheduler's condition lock): ANY kind is
@@ -167,6 +177,7 @@ POINTS = (
     "join.spill",
     "slo.predict",
     "slo.reject",
+    "fusion.decide",
 )
 
 KINDS = ("transient", "oom", "hang", "corrupt")

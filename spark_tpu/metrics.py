@@ -390,9 +390,9 @@ def reset_serve() -> None:
 #: tier's failure-driven brownout), effective-concurrency resizes, and
 #: model-journal entries loaded at startup. Shown in scheduler.status
 #: and /health.
-_SLO = {"predictions": 0, "observations": 0, "rejects": 0,
-        "brownout_enters": 0, "brownout_exits": 0, "resizes": 0,
-        "loads": 0}
+_SLO = {"predictions": 0, "observations": 0, "cold_observations": 0,
+        "rejects": 0, "brownout_enters": 0, "brownout_exits": 0,
+        "resizes": 0, "loads": 0}
 
 
 def note_slo(kind: str, n: int = 1) -> None:
@@ -439,6 +439,34 @@ def reset_agg() -> None:
     with _LOCK:
         for k in list(_AGG):
             _AGG[k] = 0
+
+
+# ---- whole-query fusion counters --------------------------------------------
+
+#: whole-query native fusion (parallel/executor.py _try_fuse) — fused
+#: programs launched (one per query that fused), exchange+consumer
+#: spans folded into them, bailouts back to staged execution (see the
+#: per-reason fusion_bailout events for the taxonomy), and injected
+#: faults absorbed at fusion.decide. Shown in tracing.fusion_profile
+#: and the bench fusion phase.
+_FUSION = {"fused_programs": 0, "fused_spans": 0, "bailouts": 0,
+           "fault_fallbacks": 0}
+
+
+def note_fusion(kind: str, n: int = 1) -> None:
+    with _LOCK:
+        _FUSION[kind] = _FUSION.get(kind, 0) + int(n)
+
+
+def fusion_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_FUSION)
+
+
+def reset_fusion() -> None:
+    with _LOCK:
+        for k in list(_FUSION):
+            _FUSION[k] = 0
 
 
 # ---- materialized-view counters ---------------------------------------------

@@ -141,6 +141,41 @@ def _sorted_by_satisfies(sorted_by, orders) -> bool:
     return True
 
 
+class _FusionOverflow(Exception):
+    """A speculative fused program sliced off live rows (sentinel mask
+    bit set): the load was genuinely skewed past the ladder anchor.
+    The result is discarded and the staged path re-runs — byte
+    identity is preserved, at double cost for the rare skewed query."""
+
+
+class _FusionBailout(Exception):
+    """A whole-query fusion attempt hit a decision that genuinely
+    needs the host (typed ``reason`` lands in the ``fusion_bailout``
+    metric event); execution degrades to the staged adaptive path."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        self.detail = detail
+        super().__init__(reason)
+
+
+def _collect_fused(plan: P.PhysicalPlan,
+                   out: List["D.FusedSpanExec"]) -> None:
+    if isinstance(plan, D.FusedSpanExec):
+        out.append(plan)
+        for t in plan.tail:  # merged chains nest further pairs here
+            if isinstance(t, D.FusedSpanExec):
+                out.append(t)
+    for c in plan.children():
+        _collect_fused(c, out)
+
+
+def _walk_plan(plan: P.PhysicalPlan):
+    yield plan
+    for c in plan.children():
+        yield from _walk_plan(c)
+
+
 @dataclass(eq=False)
 class _ShardSlot(P.PhysicalPlan):
     """Leaf placeholder inside cached stage closures (mirror of
@@ -185,10 +220,21 @@ def _fully_traceable(plan: P.PhysicalPlan) -> bool:
 class _CompactExec(P.PhysicalPlan):
     """Shrink per-device capacity to a host-chosen static size (live rows
     compact to the front). The pressure valve between stages —
-    CoalesceShufflePartitions analogue."""
+    CoalesceShufflePartitions analogue.
+
+    ``sliced`` is the fast path for outputs whose live rows already sit
+    within the first ``new_capacity`` slots on every device (exchange
+    and fused-span outputs are front-compacted by construction — the
+    compaction inside the exchange and the consumer both emit live rows
+    first, and worst-case padding only appends dead rows). A plain
+    slice then replaces the O(p log p) stable argsort over the PADDED
+    capacity with an O(new_capacity) copy; live-row order is untouched,
+    so the result is byte-identical. The caller proves slice-safety
+    from the mask readback it already does (_maybe_compact)."""
 
     new_capacity: int
     child: P.PhysicalPlan
+    sliced: bool = False
     traceable = True
 
     def children(self):
@@ -202,6 +248,15 @@ class _CompactExec(P.PhysicalPlan):
         from spark_tpu.expr.compiler import TV
 
         pipe = child_pipes[0]
+        if self.sliced:
+            cols = {
+                name: TV(tv.data[: self.new_capacity],
+                         None if tv.validity is None
+                         else tv.validity[: self.new_capacity],
+                         tv.dtype, tv.dictionary)
+                for name, tv in pipe.cols.items()
+            }
+            return Pipe(cols, pipe.mask[: self.new_capacity], pipe.order)
         perm = K.compaction_permutation(pipe.mask)
         idx = perm[: self.new_capacity]
         cols = {
@@ -213,7 +268,8 @@ class _CompactExec(P.PhysicalPlan):
         return Pipe(cols, pipe.mask[idx], pipe.order)
 
     def plan_key(self):
-        return ("Compact", self.new_capacity, self.child.plan_key())
+        return ("Compact", self.new_capacity, self.sliced,
+                self.child.plan_key())
 
 
 def _row_width(schema: Schema) -> int:
@@ -504,6 +560,13 @@ class MeshExecutor:
     def run(self, plan: P.PhysicalPlan) -> ShardedBatch:
         plan = self._materialize_boundaries(plan)
         if self._adaptive_enabled():
+            if self._fusion_enabled():
+                fused = self._try_fuse(plan)
+                if fused is not None:
+                    sb = self._run_fused(*fused)
+                    if sb is not None:
+                        return sb
+                    # speculative overflow: fall through to staged
             plan = self._materialize_exchanges(plan)
         if isinstance(plan, D.ShardScanExec):
             return plan.sharded
@@ -522,6 +585,194 @@ class MeshExecutor:
             return bool(self.conf.get(CF.ADAPTIVE_ENABLED))
         except Exception:
             return False
+
+    def _fusion_enabled(self) -> bool:
+        try:
+            return bool(self.conf.get(CF.FUSION_ENABLED))
+        except Exception:
+            return False
+
+    # ---- whole-query native fusion ------------------------------------------
+
+    def _try_fuse(self, plan: P.PhysicalPlan):
+        """Tentpole of the whole-query fusion pass: when every adaptive
+        exchange in ``plan`` pairs with a consumer whose ONLY host
+        dependency is the capacity stats fetch, rewrite the pairs into
+        FusedSpanExec nodes so the whole multi-exchange plan compiles
+        and runs as ONE XLA program with zero inter-stage host sync
+        (the on-device lax.switch over the capacity ladder replaces the
+        staged ExchangeStatsExec round-trip). Returns (plan', n_spans)
+        or None — None means take the staged path, with a typed
+        ``fusion_bailout`` event whenever a decision genuinely needed
+        the host."""
+        from spark_tpu import faults, metrics
+
+        if not _fully_traceable(plan):
+            return None  # both paths reject it; let staged raise
+        if not any(isinstance(p, _ADAPTIVE_EXCHANGES)
+                   for p in _walk_plan(plan)):
+            return None  # nothing to fuse, nothing to bail out of
+        if FORCE_ADAPTIVE.get():
+            # the OOM-degradation retry wants the staged compaction
+            # rungs — measured capacities, not worst-case fused buffers
+            self._fusion_bailout("oom_ladder",
+                                 "FORCE_ADAPTIVE retry in flight")
+            return None
+        try:
+            fused, n_spans = self._fuse_rewrite(plan)
+        except _FusionBailout as b:
+            self._fusion_bailout(b.reason, b.detail)
+            return None
+        if isinstance(fused, D.FusedSpanExec):
+            # root span: nothing above could consume the sentinel row,
+            # so the program may emit a speculative rung-sized output
+            # (overflow re-runs staged — see FusedSpanExec.speculate)
+            fused = dataclasses.replace(fused, speculate=True)
+        try:
+            # fault seam: the plan is judged fusible, the span not yet
+            # built — ANY kind degrades to staged execution (the fused
+            # program is pure plan rewriting; staged computes the
+            # identical bytes)
+            faults.inject("fusion.decide", self.conf)
+        except faults.InjectedFault as e:
+            metrics.note_fusion("fault_fallbacks")
+            metrics.record("fault_recovered", point="fusion.decide",
+                           fault=e.kind, action="staged")
+            self._fusion_bailout("fault_injected", e.kind)
+            return None
+        return fused, n_spans
+
+    def _fuse_rewrite(self, plan: P.PhysicalPlan):
+        """Rewrite adaptive exchange + consumer pairs into fused spans;
+        raises _FusionBailout on the first host-required decision. Bare
+        adaptive exchanges (no whitelisted consumer) stay inline — the
+        non-adaptive engine already runs them at static capacity inside
+        one program, byte-identically; they just skip the staged
+        compaction (``_maybe_compact`` still shrinks the final output).
+        Mirrors ``_materialize_exchanges``'s pair detection exactly, so
+        a plan fuses if and only if the staged path would have made
+        nothing but capacity decisions for it."""
+        from spark_tpu.analysis import legality
+
+        bucket = max(1, int(self.conf.get(CF.ADAPTIVE_CAPACITY_BUCKET)))
+        variants = max(1, int(self.conf.get(CF.FUSION_MAX_BUCKET_VARIANTS)))
+        spans = [0]
+
+        def pair(consumer: P.PhysicalPlan,
+                 ex: P.PhysicalPlan) -> "D.FusedSpanExec":
+            producer = rewrite(ex.child)
+            new_ex = dataclasses.replace(ex, child=producer)
+            spans[0] += 1
+            span = D.FusedSpanExec(
+                consumer=dataclasses.replace(consumer, child=new_ex),
+                exchange=new_ex, bucket=bucket, variants=variants)
+            # chain merge: when this pair's producer is another fused
+            # span reached only through row-preserving interstitials,
+            # nest this pair INSIDE the upstream span's branches (its
+            # ``tail``) instead of consuming the upstream's worst-case-
+            # padded output — every intermediate stays rung-sized and
+            # the chain still compiles to ONE switch tree / program
+            inters: List[P.PhysicalPlan] = []
+            node = producer
+            while isinstance(node, (P.ProjectExec, P.FilterExec)):
+                inters.append(node)
+                node = node.child
+            if isinstance(node, D.FusedSpanExec):
+                return dataclasses.replace(
+                    node, tail=node.tail + tuple(reversed(inters))
+                    + (span,))
+            return span
+
+        def rewrite(p: P.PhysicalPlan) -> P.PhysicalPlan:
+            if (isinstance(p, D.DistSortAggExec)
+                    and isinstance(p.child, D.HashPartitionExchangeExec)):
+                ex = p.child
+                if (isinstance(ex.child, D.DistSortAggExec)
+                        and ex.child.phase == "partial"
+                        and ex.child.groupings
+                        and self._agg_adaptive_enabled()
+                        and legality.strategy_verdict(
+                            ex.child.aggregates,
+                            ex.child.child.schema).ok):
+                    # a legal strategy crossover needs the host sketch
+                    # fetch; a PINNED pair (float partials) has only
+                    # the capacity decision left and falls through
+                    raise _FusionBailout(
+                        "agg_strategy",
+                        "strategy crossover needs the host sketch fetch")
+                if self.d > 1 and _exactly_remergeable(p, ex.child.schema):
+                    # a re-mergeable merge could skew-fan: hot
+                    # destinations are elected on the host and retraced
+                    # with static fan_destinations
+                    raise _FusionBailout(
+                        "skew_presplit",
+                        "re-mergeable consumer: destination skew fan "
+                        "is a host decision")
+                return pair(p, ex)
+            if (isinstance(p, P.SortExec)
+                    and isinstance(p.child, D.RangeExchangeExec)):
+                ex = p.child
+                sorted_by = None
+                if isinstance(ex.child, D.ShardScanExec):
+                    sorted_by = ex.child.sharded.sorted_by
+                elif (isinstance(ex.child, P.ProjectExec)
+                        and isinstance(ex.child.child, D.ShardScanExec)):
+                    sorted_by = _project_sorted_by(
+                        ex.child.child.sharded.sorted_by, ex.child.exprs)
+                if sorted_by and _sorted_by_satisfies(sorted_by, p.orders):
+                    # the staged path skips the whole Sort stage on the
+                    # producer's order guarantee — a host metadata
+                    # decision the fused program cannot make
+                    raise _FusionBailout(
+                        "sort_elide",
+                        "producer order guarantee elides the sort")
+                return pair(p, ex)
+            fields = {}
+            changed = False
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, P.PhysicalPlan):
+                    nv = rewrite(v)
+                    changed |= nv is not v
+                    fields[f.name] = nv
+                else:
+                    fields[f.name] = v
+            if isinstance(p, _ADAPTIVE_EXCHANGES):
+                spans[0] += 1  # bare exchange, kept inline
+            return dataclasses.replace(p, **fields) if changed else p
+
+        return rewrite(plan), spans[0]
+
+    def _fusion_bailout(self, reason: str, detail: str = "") -> None:
+        from spark_tpu import metrics
+
+        metrics.note_fusion("bailouts")
+        metrics.record("fusion_bailout", reason=reason, detail=detail)
+
+    def _run_fused(self, plan: P.PhysicalPlan,
+                   n_spans: int) -> Optional[ShardedBatch]:
+        from spark_tpu import metrics
+
+        try:
+            with _trace.span("stage.fused", spans=n_spans,
+                             devices=self.d):
+                sb = self._run_stage(plan)
+        except _FusionOverflow:
+            # the speculative output sliced off live rows: the load is
+            # genuinely skewed past the ladder anchor — discard and
+            # re-run staged (byte-identical, the skew fan and measured
+            # capacities belong to the host there anyway)
+            self._fusion_bailout(
+                "overflow", "live rows past the speculative output "
+                "capacity; staged re-run")
+            return None
+        metrics.note_fusion("fused_programs")
+        metrics.note_fusion("fused_spans", n_spans)
+        metrics.record("fusion", spans=n_spans, devices=self.d,
+                       capacity=sb.per_device_capacity)
+        metrics.set_gauge("fusion.last_spans", n_spans)
+        metrics.set_gauge("fusion.last_devices", self.d)
+        return sb
 
     # ---- adaptive execution (AQE over the mesh) -----------------------------
 
@@ -1082,13 +1333,24 @@ class MeshExecutor:
                                     in_specs=_SPEC, out_specs=_SPEC,
                                     check_rep=False)
             # cross-session executable store integration (no-op jit
-            # when the compile service is off)
+            # when the compile service is off). A plan holding fused
+            # spans keys under its own tier with the bucket-ladder
+            # parameters folded into the digest: the store never
+            # replays a fused executable across a ladder conf change,
+            # and prewarm replays fused programs as themselves
             from spark_tpu.compile import build_stage_callable
 
+            fused_nodes: List[D.FusedSpanExec] = []
+            _collect_fused(plan, fused_nodes)
+            tier = "fused_span" if fused_nodes else "dist"
+            extra = tuple(
+                ("ladder", f.bucket, f.variants) for f in fused_nodes
+            ) or None
             entry = (build_stage_callable(
-                "dist", plan, smapped,
+                tier, plan, smapped,
                 tuple(s.sharded.data for s in scans), schema_box,
-                mesh_size=self.d, platform=key[2]), schema_box)
+                mesh_size=self.d, platform=key[2], extra=extra),
+                schema_box)
             _DIST_STAGE_CACHE[key] = entry
         jitted, schema_box = entry
         ctx = _trace.current()
@@ -1103,6 +1365,13 @@ class MeshExecutor:
         else:
             data = jitted(tuple(s.sharded.data for s in scans))
         sb = ShardedBatch(schema_box["schema"], data, self.mesh)
+        if isinstance(plan, D.FusedSpanExec) and plan.speculate:
+            # the last slot of every shard is the overflow sentinel —
+            # check it BEFORE any compaction could move or drop it
+            p = sb.per_device_capacity
+            m = np.asarray(sb.data.row_mask).reshape(self.d, p)
+            if bool(m[:, -1].any()):
+                raise _FusionOverflow()
         n_ex = _count_exchange_nodes(plan)
         if n_ex and not self._adaptive_enabled():
             # fused-mode observability: exchanges ran inside this stage
@@ -1124,12 +1393,17 @@ class MeshExecutor:
         p = sb.per_device_capacity
         if p <= 4096:
             return sb
-        per_dev = np.asarray(sb.data.row_mask).reshape(self.d, p).sum(axis=1)
-        max_live = int(per_dev.max())
+        m = np.asarray(sb.data.row_mask).reshape(self.d, p)
+        max_live = int(m.sum(axis=1).max())
         if max_live * 4 > p:
             return sb
         new_p = K.bucket(max_live, 128)
-        return self._run_stage(_CompactExec(new_p, D.ShardScanExec(sb)))
+        # slice-safe when no live row sits past new_p on any device —
+        # true for front-compacted outputs (exchanges, fused spans),
+        # where the stable-argsort gather would be an identity move
+        sliced = not bool(m[:, new_p:].any())
+        return self._run_stage(_CompactExec(new_p, D.ShardScanExec(sb),
+                                            sliced))
 
     # ---- join lowering ------------------------------------------------------
 
@@ -1163,6 +1437,13 @@ class MeshExecutor:
                           else "exchange_join"),
                 measured_bytes=int(measured), threshold=threshold,
                 static_bytes=_estimated_bytes(right_sb))
+            if self._fusion_enabled():
+                # the broadcast switch is a measured-bytes host
+                # decision by construction — joins always execute at
+                # the staged boundary, never inside a fused span
+                self._fusion_bailout(
+                    "broadcast_switch",
+                    "join build side measured on host")
         else:
             from spark_tpu import conf as _conf
 

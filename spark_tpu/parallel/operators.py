@@ -777,6 +777,275 @@ class DistRangeAggExec(DistSortAggExec):
         return ("DistRangeAgg",) + super().plan_key()[1:]
 
 
+# ---- whole-query native fusion ----------------------------------------------
+
+
+def capacity_ladder(bucket: int, variants: int, worst: int,
+                    devices: int = 1) -> Tuple[int, ...]:
+    """The precompiled capacity rungs one fused span bakes as
+    ``lax.switch`` branches, anchored at the BALANCED receive load:
+    a well-spread exchange over ``devices`` destinations delivers
+    ~worst/devices rows to the hottest one, so the top working rung is
+    ceil(worst/devices) rounded up to the adaptive capacity bucket
+    plus ONE bucket of headroom (without the headroom, a load one row
+    past balanced spills to the next rung — 4x the buffer for a
+    rounding miss). Below the anchor the rungs refine geometrically /4
+    (bucket-rounded, same headroom) for sparse loads — aggregation
+    partials after local dedup carry far fewer live rows than the
+    producer's static capacity. The worst case (every live row routed
+    to one destination) is always the last rung, so any measured
+    incoming count is covered — the fused program can never drop a
+    live row the staged path would keep. The band BETWEEN anchor and
+    worst gets no rungs on purpose: range exchanges are balanced by
+    equi-depth sampling, and skewed hash aggregations bail out to the
+    staged skew pre-split before fusion — loads up there are the rare
+    case the worst rung exists for."""
+    bucket = max(1, int(bucket))
+    variants = max(1, int(variants))
+    worst = max(1, int(worst))
+    d = max(1, int(devices))
+    anchor = -(-worst // d)                            # balanced load
+    anchor = -(-anchor // bucket) * bucket + bucket    # round up + headroom
+    rungs: List[int] = [worst]
+    c = min(anchor, worst)
+    while len(rungs) < variants and c < rungs[-1]:
+        rungs.append(c)
+        nxt = -(-c // 4)                               # ceil(c / 4)
+        nxt = -(-nxt // bucket) * bucket + bucket
+        if nxt >= c:
+            break
+        c = nxt
+    return tuple(reversed(rungs))
+
+
+@dataclass(eq=False)
+class FusedSpanExec(P.PhysicalPlan):
+    """One adaptive exchange + consumer pair compiled as a single
+    on-device span — the whole-query fusion building block (the XLA-
+    native Flare move, arXiv 1703.08219: compile the operator boundary
+    away instead of interpreting it).
+
+    The staged path runs FOUR dispatches with a host sync in the
+    middle: producer stage, ExchangeStatsExec stage + host fetch of
+    2*d int64s, the exchange re-run at the measured capacity, then the
+    re-traced consumer stage. Here the SAME stats computation
+    (seg_count of the routing targets, psum across the mesh) stays on
+    device and a ``lax.switch`` over the capacity ladder picks the
+    rung: each branch runs the collective exchange at ITS rung's
+    slice/receive capacities, traces the consumer there, and pads the
+    result back to the common worst-case shape. Putting the collective
+    inside the branches is safe because the branch index derives from
+    psum'd counts — replicated bit-identically across the mesh — so
+    every device provably takes the same branch and the all_to_all
+    pairs up; it is what lets the fused program ship rung-sized ICI
+    buffers instead of worst-case ones, matching the staged path's
+    measured compaction to within one ladder step (4x).
+
+    Byte-identity with the staged path holds because every transform
+    is order-stable: the exchange's live-row sequence is independent
+    of slice/out capacity (stable argsort-by-destination + stable
+    compaction), the whitelisted consumers (SortExec, DistSortAggExec)
+    are capacity-preserving and capacity-independent on live rows, and
+    the padding rows are masked dead — collect never sees them. The
+    executor only builds this node when the pair's ONLY adaptive
+    decision is capacity; anything host-bound (skew fan, agg strategy
+    crossover, sort elision) bails out to staged execution first
+    (executor._try_fuse)."""
+
+    #: the consumer node, child == ``exchange`` (kept nested so schema
+    #: derivation and plan keys need no placeholder surgery; trace()
+    #: feeds it pipes directly and never walks the child link)
+    consumer: P.PhysicalPlan
+    #: the adaptive exchange (hash/range/round-robin), child == producer
+    exchange: P.PhysicalPlan
+    #: capacity-ladder base (spark.tpu.adaptive.capacityBucket)
+    bucket: int
+    #: max ladder rungs (spark.tpu.fusion.maxBucketVariants)
+    variants: int
+    #: downstream chain operators applied INSIDE this span's branches,
+    #: in dataflow order: row-preserving interstitials (Project/Filter)
+    #: and further FusedSpanExec pairs. Nesting the downstream pairs
+    #: inside the upstream branches is what keeps every intermediate
+    #: shape RUNG-sized: the chained span's routing (target hashing,
+    #: range sampling, argsort) traces over the selected rung's
+    #: capacity instead of the worst-case padding — only the single
+    #: final leaf pads to the chain's common output shape. An empty
+    #: tail is a plain one-pair span.
+    tail: Tuple[P.PhysicalPlan, ...] = ()
+    #: speculative rung-sized OUTPUT, set by the executor only when
+    #: this span is the plan root (nothing above that could touch the
+    #: sentinel row). Instead of padding the leaves to the worst case
+    #: — which makes output materialization and collection scale with
+    #: a capacity real loads never reach — the leaves emit at the
+    #: ladder anchor (+12.5% sampling margin) plus ONE sentinel slot
+    #: whose mask bit says "live rows were sliced off". The executor
+    #: reads the sentinel from the mask it fetches anyway; when set it
+    #: discards the result and re-runs the staged path (typed
+    #: ``overflow`` bailout), so byte-identity is preserved without
+    #: worst-case-shaped outputs.
+    speculate: bool = False
+    traceable = True
+
+    def children(self):
+        return self.exchange.children()
+
+    @property
+    def schema(self) -> Schema:
+        return self.tail[-1].schema if self.tail else self.consumer.schema
+
+    def trace(self, child_pipes: List[Pipe]) -> Pipe:
+        pipe = child_pipes[0]
+        d = X.axis_size()
+        # a producer padded to ITS worst case (an unmerged upstream
+        # fused span) carries a tighter total-live-rows bound than
+        # d * capacity — using it keeps chained buffers at
+        # O(total rows) instead of O(d^k * rows)
+        worst0 = d * pipe.capacity
+        if pipe.rows_bound is not None:
+            worst0 = min(worst0, int(pipe.rows_bound))
+        ladder0 = capacity_ladder(self.bucket, self.variants, worst0, d)
+        spec = self.speculate
+        if spec and len(ladder0) > 1:
+            # speculative output capacity: the ladder anchor plus a
+            # 12.5% sampling margin (range-exchange bounds come from
+            # samples; a hot destination can land a few percent past
+            # balanced without being genuinely skewed). A single-rung
+            # ladder keeps the worst-case shape — the sentinel is then
+            # constant-dead and the executor check is trivially false
+            b = max(1, int(self.bucket))
+            f_out = min(worst0,
+                        -(-(ladder0[-2] * 9 // 8) // b) * b)
+        else:
+            f_out = worst0
+        meta: dict = {}
+
+        def leaf(out: Pipe):
+            # every nested switch path returns this one common shape:
+            # f_out slots plus (speculating) one sentinel slot whose
+            # mask bit records that live rows were sliced off — the
+            # executor turns that into a staged re-run. Host-side
+            # capture at switch-build time: every leaf traces eagerly,
+            # so the dtype/dictionary metadata the pytree return
+            # strips is available to rebuild the Pipe
+            if out.capacity > f_out:
+                over = jnp.any(out.mask[f_out:])
+                out = _slice_pipe(out, f_out)
+            else:
+                over = jnp.zeros((), dtype=jnp.bool_)
+            out = _pad_pipe(out, f_out + 1 if spec else f_out)
+            mask = out.mask.at[f_out].set(over) if spec else out.mask
+            meta.setdefault("order", tuple(out.order))
+            meta.setdefault("tv", {n: (tv.dtype, tv.dictionary)
+                                   for n, tv in out.cols.items()})
+            return (mask,
+                    {n: (out.cols[n].data, out.cols[n].validity)
+                     for n in out.order})
+
+        def run_ops(p: Pipe, ops):
+            if not ops:
+                return leaf(p)
+            op, rest = ops[0], ops[1:]
+            if isinstance(op, FusedSpanExec):
+                return pair(p, op, rest)
+            return run_ops(op.trace([p]), rest)
+
+        def pair(p: Pipe, span: "FusedSpanExec", rest):
+            # the staged ExchangeStatsExec computation, kept on
+            # device: per-destination live counts, psum'd — max over
+            # destinations is exactly the staged path's measured
+            # out-capacity input
+            target = span.exchange._target(p, d)
+            local = K.seg_count(
+                jnp.clip(target, 0, d - 1).astype(jnp.int32), p.mask, d)
+            max_in = jnp.max(X.psum(local).astype(jnp.int64))
+            # total live rows through the chain never grow (the
+            # whitelisted consumers are Sort/DistSortAgg, interstitials
+            # Project/Filter), so worst0 bounds every downstream span
+            ladder = capacity_ladder(span.bucket, span.variants,
+                                     min(d * p.capacity, worst0), d)
+
+            def rung(ocap: int):
+                def branch(_):
+                    # collective INSIDE the branch, at the rung's
+                    # capacities: one sender's slice to a destination
+                    # can never exceed that destination's total
+                    # incoming rows, so min(cap, ocap) is a safe slice
+                    # bound whenever the receive rung ocap covers the
+                    # measured max_in — which branch selection
+                    # guarantees
+                    sub = X.exchange(p, target,
+                                     min(p.capacity, ocap), ocap)
+                    return run_ops(span.consumer.trace([sub]), rest)
+                return branch
+
+            arr = jnp.asarray(ladder, dtype=jnp.int64)
+            idx = jnp.clip(jnp.sum((arr < max_in).astype(jnp.int32)),
+                           0, len(ladder) - 1)
+            return jax.lax.switch(idx, [rung(c) for c in ladder], 0)
+
+        mask, flat = pair(pipe, self, tuple(self.tail))
+        cols = {n: TV(flat[n][0], flat[n][1], *meta["tv"][n])
+                for n in meta["order"]}
+        # row counts never grow through the chain, so total live rows
+        # out <= total live rows in <= worst0
+        return Pipe(cols, mask, list(meta["order"]), rows_bound=worst0)
+
+    def node_string(self):
+        chain = "".join(" -> " + (t.consumer.node_string()
+                                  if isinstance(t, FusedSpanExec)
+                                  else t.node_string())
+                        for t in self.tail)
+        return (f"FusedSpan[bucket={self.bucket}, "
+                f"variants={self.variants}, "
+                f"consumer={self.consumer.node_string()}{chain}]")
+
+    def plan_key(self):
+        # structural fingerprint of the WHOLE fused span plus the
+        # bucket-ladder parameters: the jit stage cache and the
+        # compile-store digest both key on this, so a conf change to
+        # the ladder recompiles instead of replaying a mismatched
+        # executable
+        return ("FusedSpan", self.bucket, self.variants,
+                self.speculate, self.consumer.plan_key(),
+                self.exchange.plan_key(),
+                tuple(t.plan_key() for t in self.tail))
+
+
+def _slice_pipe(pipe: Pipe, capacity: int) -> Pipe:
+    """Truncate a pipe to its first ``capacity`` slots (live rows past
+    the cut are LOST — callers must detect that and fall back; see
+    FusedSpanExec speculative output)."""
+    cols = {
+        name: TV(tv.data[:capacity],
+                 None if tv.validity is None else tv.validity[:capacity],
+                 tv.dtype, tv.dictionary)
+        for name, tv in pipe.cols.items()
+    }
+    return Pipe(cols, pipe.mask[:capacity], pipe.order)
+
+
+def _pad_pipe(pipe: Pipe, capacity: int) -> Pipe:
+    """Grow a pipe to ``capacity`` slots with dead rows (mask False, so
+    collect and every mask-respecting consumer ignore them). Needed so
+    all ladder branches return one common static shape."""
+    cap = pipe.capacity
+    if cap >= int(capacity):
+        return pipe
+    n = int(capacity) - cap
+
+    def grow(a, fill):
+        pad = ((0, n),) + ((0, 0),) * (a.ndim - 1)
+        return jnp.pad(a, pad, constant_values=fill)
+
+    cols = {
+        name: TV(grow(tv.data, 0),
+                 None if tv.validity is None else grow(tv.validity, False),
+                 tv.dtype, tv.dictionary)
+        for name, tv in pipe.cols.items()
+    }
+    return Pipe(cols, grow(pipe.mask, False), pipe.order)
+
+
 @dataclass(eq=False)
 class DistHashPartialAggExec(P.PhysicalPlan):
     """Hash-based partial aggregation over a RUNTIME-MEASURED key

@@ -36,15 +36,28 @@ from spark_tpu.types import Field, Schema
 
 
 class Pipe:
-    """Trace-time pipeline state flowing through fused operators."""
+    """Trace-time pipeline state flowing through fused operators.
 
-    __slots__ = ("cols", "mask", "order")
+    ``rows_bound``, when set, is a static upper bound on the TOTAL live
+    rows across the whole mesh — tighter than ``d * capacity`` when the
+    pipe was padded to a worst-case shape (fused spans pad their output
+    to the capacity-ladder worst while carrying far fewer live rows).
+    Chained fused spans use it to size their ladder from real row
+    counts instead of the upstream padding, which is what keeps a
+    k-span chain's buffers at O(total rows) rather than O(d^k * rows).
+    Row-preserving operators (Project, Filter) thread it through; any
+    operator that can grow row counts simply drops it, which is always
+    safe (consumers fall back to d * capacity)."""
+
+    __slots__ = ("cols", "mask", "order", "rows_bound")
 
     def __init__(self, cols: Dict[str, TV], mask: jnp.ndarray,
-                 order: Sequence[str]):
+                 order: Sequence[str],
+                 rows_bound: Optional[int] = None):
         self.cols = cols
         self.mask = mask
         self.order = list(order)
+        self.rows_bound = rows_bound
 
     @property
     def capacity(self) -> int:
@@ -311,7 +324,7 @@ class ProjectExec(PhysicalPlan):
                 continue
             cols[e.name] = tv
             order.append(e.name)
-        return Pipe(cols, pipe.mask, order)
+        return Pipe(cols, pipe.mask, order, rows_bound=pipe.rows_bound)
 
     def node_string(self):
         return f"Project[{', '.join(str(e) for e in self.exprs)}]"
@@ -338,7 +351,8 @@ class FilterExec(PhysicalPlan):
         pipe = child_pipes[0]
         tv = C.evaluate(self.condition, pipe.env())
         keep = tv.data & tv.valid_or_true(pipe.capacity)
-        return Pipe(pipe.cols, pipe.mask & keep, pipe.order)
+        return Pipe(pipe.cols, pipe.mask & keep, pipe.order,
+                    rows_bound=pipe.rows_bound)
 
     def node_string(self):
         return f"Filter[{self.condition}]"

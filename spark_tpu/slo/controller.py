@@ -194,34 +194,49 @@ class SloController:
                 return
             run_ms = (ticket.finished_t - ticket.started_t) * 1e3
             queue_ms = ticket.queue_wait_ms() or 0.0
-            device_ms, transfer_ms = self._span_components(ticket)
-            with trace.span("slo.observe", fp=fp):
+            device_ms, transfer_ms, cold = self._span_components(ticket)
+            with trace.span("slo.observe", fp=fp, cold=cold):
                 self.model.observe(
                     fp, run_ms=run_ms, queue_ms=queue_ms,
                     rows=getattr(ticket, "slo_rows", None),
-                    device_ms=device_ms, transfer_ms=transfer_ms)
+                    device_ms=device_ms, transfer_ms=transfer_ms,
+                    cold=cold)
             self._note_ratios(queue_ms, run_ms)
         except Exception:
             pass
 
     @staticmethod
     def _span_components(ticket):
-        """device/transfer ms from the query's span events — present
-        only when trace sampling recorded them; (0, 0) otherwise."""
+        """(device_ms, transfer_ms, cold) from the query's trace
+        events — components present only when trace sampling recorded
+        them, (0, 0) otherwise. Span events carry their wall time as
+        ``ms`` (trace.span); ``duration_ms`` is kept as a legacy
+        fallback for externally-fed event logs. ``cold`` flags a
+        compile-store miss inside this trace (an ``aot_compile`` ran,
+        or failed trying): the run's wall time is dominated by
+        compilation, and the model quarantines it in the cold
+        component instead of folding it into the warm run-time
+        EWMA."""
         device_ms = transfer_ms = 0.0
+        cold = False
         try:
             ctx = getattr(ticket, "_trace_ctx", None)
             if ctx and getattr(ctx, "trace_id", None):
                 for ev in metrics.query_events(ctx.trace_id):
+                    if (ev.get("kind") == "compile"
+                            and ev.get("phase") in ("aot_compile",
+                                                    "aot_failed")):
+                        cold = True
                     name = ev.get("span") or ev.get("name") or ""
-                    dur = float(ev.get("duration_ms") or 0.0)
+                    dur = float(ev.get("duration_ms")
+                                or ev.get("ms") or 0.0)
                     if name == "stage.device":
                         device_ms += dur
                     elif name == "pipeline.transfer":
                         transfer_ms += dur
         except Exception:
             pass
-        return device_ms, transfer_ms
+        return device_ms, transfer_ms, cold
 
     def _note_ratios(self, queue_ms: float, run_ms: float) -> None:
         """Auto-size effective concurrency from the queue/run ratio:

@@ -6,11 +6,22 @@ plans cost in the past. State is one EWMA record per plan fingerprint
 replica's prediction state and replay state describe the same keys):
 
     host_ms / device_ms / queue_ms / transfer_ms / run_ms / rows / n
+    (+ cold_ms / cold_n — see below)
 
 ``run_ms`` is the directly-measured wall time of the scheduler's run
 phase (always available); the component EWMAs come from trace span
 events when sampling is on (best-effort — they refine the row-count
 scaling but the prediction never depends on them existing).
+
+Cold-compile runs are quarantined: a query whose trace shows the
+compile store MISSED (an ``aot_compile``/``aot_failed`` compile event
+inside the trace) folds its wall time into a separate ``cold_ms``/
+``cold_n`` component and leaves every warm EWMA untouched — one cold
+outlier used to multiply the run-time estimate by the compile time
+and poison admission for the next N queries. ``predict_run_ms`` stays
+warm-only (a replayed/prewarmed plan never pays the compile again);
+``cold_ms`` is observability for the snapshot and bench. Journals
+written before this field existed load with cold_ms = cold_n = 0.
 
 Prediction scales the device+transfer share by the ratio of the
 query's input-row count to the EWMA'd historical row count (scan-stat
@@ -107,7 +118,8 @@ class LatencyModel:
         self.max_entries = max(8, int(max_entries))
         self._lock = locks.named_lock("slo.model")
         #: fp -> {host_ms, device_ms, queue_ms, transfer_ms, run_ms,
-        #:        rows, n} — OrderedDict as LRU (move_to_end on touch)
+        #:        rows, n, cold_ms, cold_n} — OrderedDict as LRU
+        #: (move_to_end on touch)
         self._state: "OrderedDict[str, Dict[str, float]]" = OrderedDict()
         self._appends = 0
         self._load()
@@ -133,9 +145,14 @@ class LatencyModel:
                 rec = json.loads(line)
                 fp = rec.pop("fp")
                 loaded.pop(fp, None)  # last-line-wins, refresh LRU slot
-                loaded[fp] = {k: float(rec[k]) for k in
-                              ("host_ms", "device_ms", "queue_ms",
-                               "transfer_ms", "run_ms", "rows", "n")}
+                cur = {k: float(rec[k]) for k in
+                       ("host_ms", "device_ms", "queue_ms",
+                        "transfer_ms", "run_ms", "rows", "n")}
+                # cold component post-dates the journal format: old
+                # lines load as never-cold rather than being dropped
+                cur["cold_ms"] = float(rec.get("cold_ms", 0.0))
+                cur["cold_n"] = float(rec.get("cold_n", 0.0))
+                loaded[fp] = cur
             except Exception:
                 continue  # tolerate torn/garbage lines
         while len(loaded) > self.max_entries:
@@ -181,9 +198,12 @@ class LatencyModel:
 
     def observe(self, fp: str, *, run_ms: float, queue_ms: float = 0.0,
                 rows: Optional[float] = None, device_ms: float = 0.0,
-                transfer_ms: float = 0.0) -> None:
+                transfer_ms: float = 0.0, cold: bool = False) -> None:
         """Fold one completed query into the fingerprint's EWMAs and
-        journal the updated snapshot. Never raises."""
+        journal the updated snapshot. ``cold=True`` (the trace showed a
+        compile-store miss) updates ONLY the quarantined cold
+        component — the warm run-time estimate never sees the compile
+        outlier. Never raises."""
         if not fp or run_ms is None or run_ms < 0:
             return
         host_ms = max(0.0, float(run_ms) - float(device_ms)
@@ -192,14 +212,41 @@ class LatencyModel:
             with self._lock:
                 cur = self._state.pop(fp, None)
                 a = self.alpha
-                if cur is None:
+                if cold:
+                    if cur is None:
+                        cur = {"host_ms": 0.0, "device_ms": 0.0,
+                               "queue_ms": 0.0, "transfer_ms": 0.0,
+                               "run_ms": 0.0,
+                               "rows": float(rows) if rows else 0.0,
+                               "n": 0.0, "cold_ms": float(run_ms),
+                               "cold_n": 1.0}
+                    elif cur.get("cold_n", 0.0) <= 0:
+                        cur["cold_ms"] = float(run_ms)
+                        cur["cold_n"] = 1.0
+                    else:
+                        cur["cold_ms"] = ((1 - a) * cur["cold_ms"]
+                                          + a * float(run_ms))
+                        cur["cold_n"] = cur.get("cold_n", 0.0) + 1.0
+                elif cur is None:
                     cur = {"host_ms": host_ms,
                            "device_ms": float(device_ms),
                            "queue_ms": float(queue_ms),
                            "transfer_ms": float(transfer_ms),
                            "run_ms": float(run_ms),
                            "rows": float(rows) if rows else 0.0,
-                           "n": 1.0}
+                           "n": 1.0, "cold_ms": 0.0, "cold_n": 0.0}
+                elif cur.get("n", 0.0) <= 0:
+                    # first WARM observation of an entry a cold run
+                    # created: seed directly — folding against the
+                    # zeroed placeholders would bias the estimate low
+                    cur.update({"host_ms": host_ms,
+                                "device_ms": float(device_ms),
+                                "queue_ms": float(queue_ms),
+                                "transfer_ms": float(transfer_ms),
+                                "run_ms": float(run_ms)})
+                    if rows:
+                        cur["rows"] = float(rows)
+                    cur["n"] = 1.0
                 else:
                     for key, obs in (("host_ms", host_ms),
                                      ("device_ms", float(device_ms)),
@@ -219,7 +266,8 @@ class LatencyModel:
             try:
                 from spark_tpu import metrics
 
-                metrics.note_slo("observations")
+                metrics.note_slo("cold_observations" if cold
+                                 else "observations")
             except Exception:
                 pass
         except Exception:
@@ -234,7 +282,9 @@ class LatencyModel:
             return None
         with self._lock:
             cur = self._state.get(fp)
-            if cur is None:
+            if cur is None or cur.get("n", 0.0) < 1.0:
+                # cold-only entries predict nothing: the only signal is
+                # compile time, which a warm run never pays again
                 return None
             self._state.move_to_end(fp)
             hist_rows = cur.get("rows", 0.0)
@@ -265,7 +315,10 @@ class LatencyModel:
                     "path": self.path,
                     "alpha": self.alpha,
                     "observations": sum(v.get("n", 0.0)
-                                        for v in self._state.values())}
+                                        for v in self._state.values()),
+                    "cold_observations": sum(
+                        v.get("cold_n", 0.0)
+                        for v in self._state.values())}
 
 
 def model_path_from_conf(conf) -> str:

@@ -70,6 +70,8 @@ SPAN_NAMES = frozenset({
     "query.analysis",       # static plan analysis + submit gate
     "compile.probe",        # AOT executable-store lookup
     "stage.run",            # one physical stage (host glue + device)
+    "stage.fused",          # whole-query fused span: multi-exchange
+                            # plan as ONE XLA program, zero host sync
     "stage.device",         # device execution, block_until_ready-bounded
     "exchange.stats",       # AQE host round-trip fetching device stats
     "agg.decide",           # adaptive-agg sketch fetch + strategy pick

@@ -119,6 +119,12 @@ def pytest_configure(config):
         "slo: SLO-driven serving (spark_tpu/slo/) — per-plan latency "
         "prediction, EDF scheduling, reject-at-admission, predictive "
         "brownout, on/off byte-identity")
+    config.addinivalue_line(
+        "markers",
+        "fusion: whole-query native fusion — on-device adaptive "
+        "capacity decisions, single-XLA-program multi-stage spans, "
+        "bucket-ladder branch selection, staged-fallback bailouts, "
+        "on/off byte-identity")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -130,7 +136,8 @@ def pytest_collection_modifyitems(config, items):
                 or "mview" in item.keywords or "agg" in item.keywords
                 or "trace" in item.keywords
                 or "chaos" in item.keywords
-                or "slo" in item.keywords) \
+                or "slo" in item.keywords
+                or "fusion" in item.keywords) \
                 and item.get_closest_marker("timeout") is None:
             item.add_marker(pytest.mark.timeout(300))
     if config.getoption("--runslow"):

@@ -1,0 +1,468 @@
+"""Whole-query native fusion (parallel/executor._try_fuse +
+parallel/operators.FusedSpanExec) — the XLA-native Flare move: adaptive
+exchange + consumer pairs whose only host dependency is the capacity
+stats fetch compile into ONE program, with the psum/pmax stats kept on
+device and a lax.switch over the capacity-bucket ladder replacing the
+staged ExchangeStatsExec round-trip.
+
+The hard invariant under test: ``spark.tpu.fusion.enabled`` never
+changes RESULT BYTES — fused vs staged compare exactly (float payloads
+included: the exchange's live-row sequence is capacity-independent and
+the whitelisted consumers are order-stable), across devices {1, 2, 8},
+uniform and skewed data, at ladder-edge capacities, through every
+bailout path, and under every injected-fault kind at ``fusion.decide``.
+"""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_tpu.conf as CF
+import spark_tpu.expr.expressions as E
+import spark_tpu.plan.logical as L
+from spark_tpu import faults, metrics
+from spark_tpu.columnar.arrow import from_arrow
+from spark_tpu.conf import RuntimeConf
+from spark_tpu.parallel import operators as D
+from spark_tpu.parallel.executor import MeshExecutor
+from spark_tpu.parallel.mesh import make_mesh
+from spark_tpu.parallel.operators import FusedSpanExec, capacity_ladder
+from spark_tpu.physical import kernels as K
+from spark_tpu.physical import operators as P
+from spark_tpu.physical.planner import execute_logical
+
+pytestmark = pytest.mark.fusion
+
+_MESHES = {}
+
+
+def _mesh(d):
+    if d not in _MESHES:
+        _MESHES[d] = make_mesh(d)
+    return _MESHES[d]
+
+
+def _executor(d, fusion, **overrides):
+    conf = RuntimeConf({"spark.tpu.adaptive.enabled": True,
+                        "spark.tpu.fusion.enabled": bool(fusion),
+                        **overrides})
+    return MeshExecutor(_mesh(d), conf=conf)
+
+
+def _rows(batch):
+    return [tuple(r.values()) for r in batch.to_pylist()]
+
+
+def _table(keys, vals):
+    return L.Relation(from_arrow(pa.table({
+        "k": pa.array(np.asarray(keys, np.int64), pa.int64()),
+        "v": pa.array(np.asarray(vals, np.int64), pa.int64()),
+        "f": pa.array(np.asarray(vals, np.float64) * 0.25 + 0.1,
+                      pa.float64()),
+    })))
+
+
+def _dataset(dist, rng, n=6000):
+    if dist == "uniform":
+        keys = rng.integers(0, 200, n)
+    else:  # skewed: 90% of rows share one key
+        keys = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 200, n))
+    return _table(keys, rng.integers(0, 1000, n))
+
+
+def _q5_shape(rel):
+    """Multi-exchange plan shaped like TPC-H q5's tail: groupby with a
+    FLOAT aggregate (strategy-pinned, so the pair's only adaptive
+    decision is capacity -> it fuses) under a global sort — two
+    adaptive exchanges, two fused spans."""
+    agg = L.Aggregate(
+        (E.Col("k"),),
+        (E.Col("k"), E.Alias(E.Sum(E.Col("f")), "fs"),
+         E.Alias(E.Count(E.Col("v")), "n")),
+        rel)
+    return L.Sort((E.SortOrder(E.Col("k")),), agg)
+
+
+def _bailout_reasons(evs):
+    return [e.get("reason") for e in evs
+            if e.get("kind") == "fusion_bailout"]
+
+
+# ---- the hard invariant: byte-identical results fused vs staged -------------
+
+
+# tier-1 keeps the multi-device cells; the single-device cells (a
+# trivial mesh, covered structurally by everything else) ride the slow
+# lane (--runslow / -m fusion) so the default suite stays inside its
+# wall budget
+@pytest.mark.parametrize("devices", [
+    pytest.param(1, marks=pytest.mark.slow), 2, 8])
+@pytest.mark.parametrize("dist", ["uniform", "skewed"])
+@pytest.mark.timeout(300)
+def test_byte_identity_fused_sweep(devices, dist, rng):
+    plan = _q5_shape(_dataset(dist, rng))
+    # d=8 runs the full default ladder; the d<8 cells pin a 2-rung
+    # ladder — same switch machinery, ~4x fewer compiled branch paths
+    # on the 1-core CI box (v^spans with chain-merged spans)
+    over = ({} if devices == 8
+            else {"spark.tpu.fusion.maxBucketVariants": 2})
+    metrics.reset_fusion()
+    staged = _rows(_executor(devices, False, **over).execute_logical(plan))
+    assert metrics.fusion_stats()["fused_programs"] == 0
+    fused = _rows(_executor(devices, True, **over).execute_logical(plan))
+    st = metrics.fusion_stats()
+    # exact equality, float payloads included: the fused lax.switch
+    # must select a capacity whose compaction preserves live-row order
+    assert fused == staged
+    assert st["fused_programs"] == 1
+    assert st["fused_spans"] == 2  # the agg pair + the sort pair
+    assert st["bailouts"] == 0
+
+
+@pytest.mark.parametrize("devices", [
+    pytest.param(1, marks=pytest.mark.slow), 2,
+    pytest.param(8, marks=pytest.mark.slow)])
+@pytest.mark.timeout(300)
+def test_byte_identity_q3_join_groupby(devices, rng):
+    """q3 shape: join -> groupby(float sum) -> sort. The join boundary
+    always executes staged (its broadcast switch is a measured-bytes
+    host decision -> fusion_bailout), but the post-join agg + sort
+    exchanges fuse into one program."""
+    n = 4000
+    left = _dataset("skewed", rng, n)
+    right = L.Relation(from_arrow(pa.table({
+        "k2": pa.array(np.arange(200, dtype=np.int64), pa.int64()),
+        "w": pa.array(np.arange(200, dtype=np.int64) * 3, pa.int64()),
+    })))
+    join = L.Join(left, right, "inner", (E.Col("k"),), (E.Col("k2"),))
+    plan = L.Sort((E.SortOrder(E.Col("k")),), L.Aggregate(
+        (E.Col("k"),), (E.Col("k"), E.Alias(E.Sum(E.Col("f")), "fs")),
+        join))
+    over = {"spark.tpu.fusion.maxBucketVariants": 2}  # compile budget
+    metrics.query_start("fusion-q3-staged")
+    staged = _rows(_executor(devices, False, **over).execute_logical(plan))
+    metrics.query_start("fusion-q3-fused")
+    metrics.reset_fusion()
+    fused = _rows(_executor(devices, True, **over).execute_logical(plan))
+    st = metrics.fusion_stats()
+    assert fused == staged
+    assert st["fused_programs"] >= 1
+    assert "broadcast_switch" in _bailout_reasons(metrics.last_query())
+
+
+@pytest.mark.timeout(300)
+def test_overflow_sentinel_bails_to_staged(rng):
+    """Speculative output: the root fused span emits at the balanced
+    anchor (+12.5% headroom), not the worst case. A constant sort key
+    routes EVERY row to one device — past the speculative capacity —
+    so the on-device sentinel must trip and the executor must re-run
+    staged (typed 'overflow' bailout), still byte-identical."""
+    n = 2000
+    plan = L.Sort((E.SortOrder(E.Col("v")),),
+                  _table(rng.integers(0, 5, n), np.full(n, 7)))
+    over = {"spark.tpu.adaptive.capacityBucket": 64}
+    staged = _rows(_executor(2, False, **over).execute_logical(plan))
+    metrics.query_start("fusion-overflow")
+    metrics.reset_fusion()
+    fused = _rows(_executor(2, True, **over).execute_logical(plan))
+    assert fused == staged
+    assert "overflow" in _bailout_reasons(metrics.last_query())
+    assert metrics.fusion_stats()["bailouts"] >= 1
+
+
+# ---- the capacity ladder ----------------------------------------------------
+
+
+def test_capacity_ladder_shape():
+    # rungs descend geometrically (/4) from a balanced-load anchor
+    # (ceil(worst/devices) rounded up to the bucket, plus one bucket of
+    # headroom); the worst case is always the final covering rung.
+    assert capacity_ladder(1024, 4, 400384, 8) == (5120, 14336, 51200, 400384)
+    assert capacity_ladder(1024, 4, 65536, 8) == (2048, 4096, 9216, 65536)
+    # single device: the anchor meets the worst case, one covering rung
+    assert capacity_ladder(1024, 4, 65536) == (65536,)
+    # worst below the anchor bucket: a single covering rung
+    assert capacity_ladder(1024, 4, 512, 8) == (512,)
+    # variants bound respected
+    assert capacity_ladder(64, 2, 1 << 20, 8) == (131136, 1 << 20)
+    assert capacity_ladder(64, 1, 1 << 20, 8) == (1 << 20,)
+    # rungs are bucket multiples (or the worst case itself)
+    assert all(c % 1000 == 0 or c == 70001
+               for c in capacity_ladder(1000, 4, 70001, 8))
+    # some non-worst rung covers the balanced per-device load, so an
+    # evenly spread exchange never has to pad to the worst case
+    ladder = capacity_ladder(1024, 4, 400384, 8)
+    assert any(c >= -(-400384 // 8) for c in ladder[:-1])
+    # degenerate inputs clamp instead of raising
+    assert capacity_ladder(0, 0, 0) == (1,)
+
+
+# tier-1 runs the exact lowest-rung boundary pair; the higher-rung
+# edges stay on the slow lane (each distinct n is its own compile on
+# the 1-core CI box)
+@pytest.mark.parametrize("n", [
+    pytest.param(63, marks=pytest.mark.slow), 64, 65,
+    pytest.param(255, marks=pytest.mark.slow),
+    pytest.param(256, marks=pytest.mark.slow),
+    pytest.param(257, marks=pytest.mark.slow)])
+@pytest.mark.timeout(300)
+def test_ladder_edge_cells_vs_staged_oracle(n, rng):
+    """All-distinct keys land the measured incoming count exactly on /
+    around rung boundaries of a tiny bucket=64 ladder (rungs 64, 256,
+    1024, ...): the on-device switch must pick a covering rung and stay
+    byte-identical to the staged oracle at every edge."""
+    keys = np.arange(n, dtype=np.int64)
+    plan = _q5_shape(_table(keys, rng.integers(0, 1000, n)))
+    over = {"spark.tpu.adaptive.capacityBucket": 64,
+            "spark.tpu.fusion.maxBucketVariants": 2}  # compile budget
+    staged = _rows(_executor(2, False, **over).execute_logical(plan))
+    metrics.reset_fusion()
+    fused = _rows(_executor(2, True, **over).execute_logical(plan))
+    assert fused == staged
+    assert metrics.fusion_stats()["fused_programs"] == 1
+    # sanity vs the single-device oracle (ulp-tolerant on the float sum)
+    oracle = _rows(execute_logical(plan))
+    assert len(oracle) == len(fused)
+    for o, f in zip(oracle, fused):
+        assert o[0] == f[0] and o[2] == f[2]
+        assert f[1] == pytest.approx(o[1], rel=1e-9)
+
+
+def test_fused_span_plan_key_and_digest_include_ladder():
+    """Tentpole (b): the compile store keys a fused program on the
+    structural fingerprint of the whole span PLUS the bucket ladder —
+    a ladder conf change must never replay a mismatched executable."""
+    from spark_tpu.compile.store import stable_plan_fingerprint
+    from spark_tpu.parallel.sharded import ShardedBatch
+    from spark_tpu.columnar.arrow import from_arrow as _fa
+
+    sb = ShardedBatch.from_batch(_fa(pa.table({
+        "k": pa.array(np.arange(8, dtype=np.int64), pa.int64())})),
+        _mesh(2))
+    ex = D.HashPartitionExchangeExec((E.Col("k"),), D.ShardScanExec(sb))
+    sort = P.SortExec((E.SortOrder(E.Col("k")),), ex)
+
+    def span(bucket, variants):
+        return FusedSpanExec(consumer=sort, exchange=ex,
+                             bucket=bucket, variants=variants)
+
+    a, b, c = span(1024, 4), span(512, 4), span(1024, 8)
+    assert a.plan_key() != b.plan_key()
+    assert a.plan_key() != c.plan_key()
+    digests = {stable_plan_fingerprint(
+        "fused_span", s, (), mesh_size=2, platform="cpu",
+        extra=(("ladder", s.bucket, s.variants),))
+        for s in (a, b, c)}
+    assert len(digests) == 3
+
+
+# ---- bailout paths: typed reason + byte identity ----------------------------
+
+
+@pytest.mark.timeout(300)
+def test_bailout_agg_strategy(rng):
+    """An INT aggregate passes legality.strategy_verdict, so the agg
+    crossover is a live host decision -> the whole plan stays staged
+    with reason agg_strategy, bytes identical."""
+    plan = L.Sort((E.SortOrder(E.Col("k")),), L.Aggregate(
+        (E.Col("k"),), (E.Col("k"), E.Alias(E.Sum(E.Col("v")), "s")),
+        _dataset("uniform", rng)))
+    staged = _rows(_executor(8, False).execute_logical(plan))
+    metrics.query_start("fusion-bailout-agg")
+    metrics.reset_fusion()
+    fused = _rows(_executor(8, True).execute_logical(plan))
+    st = metrics.fusion_stats()
+    assert fused == staged
+    assert st["fused_programs"] == 0 and st["bailouts"] >= 1
+    assert "agg_strategy" in _bailout_reasons(metrics.last_query())
+
+
+@pytest.mark.timeout(300)
+def test_bailout_skew_presplit(rng):
+    """With the agg crossover disabled, a re-mergeable (int) final
+    merge could still skew-fan hot destinations — elected on the host
+    from fetched stats -> reason skew_presplit, bytes identical."""
+    plan = L.Aggregate(
+        (E.Col("k"),), (E.Col("k"), E.Alias(E.Sum(E.Col("v")), "s")),
+        _dataset("skewed", rng))
+    over = {"spark.tpu.adaptive.agg.enabled": False}
+    staged = sorted(_rows(_executor(8, False, **over)
+                          .execute_logical(plan)))
+    metrics.query_start("fusion-bailout-skew")
+    metrics.reset_fusion()
+    fused = sorted(_rows(_executor(8, True, **over)
+                         .execute_logical(plan)))
+    st = metrics.fusion_stats()
+    assert fused == staged
+    assert st["fused_programs"] == 0 and st["bailouts"] >= 1
+    assert "skew_presplit" in _bailout_reasons(metrics.last_query())
+
+
+@pytest.mark.timeout(300)
+def test_bailout_broadcast_switch(rng):
+    """A join under adaptive execution measures the build side on the
+    host — fusion records the broadcast_switch bailout and the joined
+    result stays byte-identical fused vs staged (covered on the full
+    q3 shape by test_byte_identity_q3_join_groupby; this pins the
+    bare-join case where NOTHING fuses)."""
+    n = 2000
+    left = _dataset("uniform", rng, n)
+    right = L.Relation(from_arrow(pa.table({
+        "k2": pa.array(np.arange(64, dtype=np.int64), pa.int64()),
+        "w": pa.array(np.arange(64, dtype=np.int64) * 10, pa.int64()),
+    })))
+    join = L.Join(left, right, "inner", (E.Col("k"),), (E.Col("k2"),))
+    staged = sorted(_rows(_executor(8, False).execute_logical(join)))
+    metrics.query_start("fusion-bailout-bcast")
+    metrics.reset_fusion()
+    fused = sorted(_rows(_executor(8, True).execute_logical(join)))
+    assert fused == staged
+    assert "broadcast_switch" in _bailout_reasons(metrics.last_query())
+
+
+@pytest.mark.timeout(300)
+def test_bailout_oom_ladder(rng):
+    """The FORCE_ADAPTIVE OOM-retry contextvar wants the staged
+    compaction rungs (measured capacities, not worst-case fused
+    buffers): fusion steps aside with reason oom_ladder."""
+    from spark_tpu.parallel import executor as X
+
+    plan = _q5_shape(_dataset("uniform", rng))
+    staged = _rows(_executor(2, False).execute_logical(plan))
+    metrics.query_start("fusion-bailout-oom")
+    metrics.reset_fusion()
+    token = X.FORCE_ADAPTIVE.set(True)
+    try:
+        fused = _rows(_executor(2, True).execute_logical(plan))
+    finally:
+        X.FORCE_ADAPTIVE.reset(token)
+    assert fused == staged
+    assert metrics.fusion_stats()["fused_programs"] == 0
+    assert "oom_ladder" in _bailout_reasons(metrics.last_query())
+
+
+@pytest.mark.timeout(300)
+def test_bailout_sort_elide(rng):
+    """A producer whose ShardedBatch carries a sorted_by guarantee lets
+    the staged path skip the whole Sort stage — a host metadata
+    decision the fused program cannot make, so the rewrite itself bails
+    with reason sort_elide before building any span."""
+    from spark_tpu.parallel.executor import _FusionBailout
+    from spark_tpu.parallel.sharded import ShardedBatch
+
+    batch = from_arrow(pa.table({
+        "k": pa.array(np.arange(64, dtype=np.int64), pa.int64())}))
+    sb = ShardedBatch.from_batch(batch, _mesh(2))
+    sb.sorted_by = (("k", True, True),)
+    orders = (E.SortOrder(E.Col("k")),)
+    plan = P.SortExec(orders, D.RangeExchangeExec(
+        orders, D.ShardScanExec(sb)))
+    ex = _executor(2, True)
+    with pytest.raises(_FusionBailout) as exc:
+        ex._fuse_rewrite(plan)
+    assert exc.value.reason == "sort_elide"
+    # end to end the executor absorbs the bailout: staged fallback,
+    # typed event, bytes identical to fusion-off
+    staged = _rows(_executor(2, False).run(plan).to_batch())
+    metrics.query_start("fusion-bailout-elide")
+    metrics.reset_fusion()
+    fused = _rows(_executor(2, True).run(plan).to_batch())
+    assert fused == staged
+    assert metrics.fusion_stats()["fused_programs"] == 0
+    assert "sort_elide" in _bailout_reasons(metrics.last_query())
+
+
+# ---- fault matrix: every kind at fusion.decide -> staged, identical ---------
+
+
+@pytest.mark.parametrize("kind", faults.KINDS)
+@pytest.mark.timeout(300)
+def test_fault_matrix_fusion_decide(kind, rng):
+    plan = _q5_shape(_dataset("uniform", rng))
+    staged = _rows(_executor(2, False).execute_logical(plan))
+    metrics.query_start(f"fusion-fault-{kind}")
+    metrics.reset_fusion()
+    got = _rows(_executor(
+        2, True,
+        **{"spark.tpu.faultInjection.fusion.decide": f"nth:1:{kind}"}
+    ).execute_logical(plan))
+    st = metrics.fusion_stats()
+    assert got == staged
+    assert st["fault_fallbacks"] == 1 and st["fused_programs"] == 0
+    evs = metrics.last_query()
+    rec = [e for e in evs if e.get("kind") == "fault_recovered"
+           and e.get("point") == "fusion.decide"]
+    assert rec and rec[0].get("fault") == kind
+    assert rec[0].get("action") == "staged"
+    assert "fault_injected" in _bailout_reasons(evs)
+
+
+# ---- registration discipline ------------------------------------------------
+
+
+def test_fusion_conf_declaration_scan():
+    """Every spark.tpu.fusion.* key used anywhere in the source must be
+    registered in conf.py with a real doc and default (the declaration
+    contract the storage/adaptive suites pioneered)."""
+    root = os.path.join(os.path.dirname(__file__), "..", "spark_tpu")
+    used = set()
+    for path in glob.glob(os.path.join(root, "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            used.update(re.findall(
+                r"spark\.tpu\.fusion\.\w+(?:\.\w+)*", f.read()))
+    assert used, "no fusion conf keys found in source"
+    for key in used:
+        assert key in CF._REGISTRY, f"{key} not registered in conf.py"
+        entry = CF._REGISTRY[key]
+        assert entry.doc and len(entry.doc) > 20, f"{key} lacks a doc"
+        assert entry.default is not None, f"{key} lacks a default"
+
+
+def test_fusion_point_and_span_registered():
+    from spark_tpu import trace
+
+    assert "fusion.decide" in faults.POINTS
+    assert "stage.fused" in trace.SPAN_NAMES
+    # counter family present and resettable
+    metrics.note_fusion("fused_programs")
+    assert metrics.fusion_stats()["fused_programs"] >= 1
+    metrics.reset_fusion()
+    assert metrics.fusion_stats()["fused_programs"] == 0
+
+
+# ---- the perf claim: zero inter-stage host sync inside the fused span -------
+
+
+@pytest.mark.timeout(300)
+def test_fused_trace_has_no_exchange_stats_spans(rng):
+    """The staged path records one exchange.stats span (a device->host
+    fetch) per adaptive exchange; the fused program must record NONE —
+    that host round-trip is exactly what fusion compiles away — and one
+    stage.fused span instead."""
+    plan = _q5_shape(_dataset("uniform", rng))
+    over = {"spark.tpu.fusion.maxBucketVariants": 2}  # compile budget:
+    # same ladder + dataset as the d=2 sweep cell -> warm program cache
+    metrics.query_start("fusion-trace-staged")
+    _executor(2, False, **over).execute_logical(plan)
+    staged_evs = metrics.last_query()
+    staged_stats = [e for e in staged_evs
+                    if e.get("kind") == "span"
+                    and e.get("name") == "exchange.stats"]
+    assert len(staged_stats) >= 2
+
+    metrics.query_start("fusion-trace-fused")
+    _executor(2, True, **over).execute_logical(plan)
+    fused_evs = metrics.last_query()
+    assert not [e for e in fused_evs
+                if e.get("kind") == "span"
+                and e.get("name") == "exchange.stats"]
+    fused_spans = [e for e in fused_evs
+                   if e.get("kind") == "span"
+                   and e.get("name") == "stage.fused"]
+    assert len(fused_spans) == 1
+    assert fused_spans[0].get("spans") == 2

@@ -382,6 +382,55 @@ def test_model_tolerates_torn_journal(tmp_path):
     assert m2.predict_run_ms(fp) == pytest.approx(42.0)
 
 
+def test_model_cold_compile_quarantine(tmp_path):
+    """A compile-store-miss run (cold=True) must never touch the warm
+    run-time EWMA: one 5000ms cold outlier followed by N 50ms warm runs
+    predicts ~50ms, exactly as if the cold run never happened — the
+    regression this guards multiplied the estimate by the compile time
+    and poisoned reject-at-admission for the next N queries."""
+    path = str(tmp_path / "cold.jsonl")
+    m = LatencyModel(path, alpha=0.5)
+    fp = "sql:" + "c" * 24
+    # cold-only entries predict nothing (a warm run never pays the
+    # compile again, so compile time is not a run-time signal)
+    m.observe(fp, run_ms=5000.0, cold=True)
+    assert m.predict_run_ms(fp) is None
+    assert m.snapshot()["cold_observations"] == 1.0
+    # first warm observation SEEDS the warm EWMA directly — folding
+    # against the cold entry's zeroed placeholders would bias it low
+    m.observe(fp, run_ms=50.0)
+    assert m.predict_run_ms(fp) == pytest.approx(50.0)
+    for _ in range(4):
+        m.observe(fp, run_ms=50.0)
+    assert m.predict_run_ms(fp) == pytest.approx(50.0)
+    # a later cold outlier (store eviction, conf change) still only
+    # moves the quarantined component
+    m.observe(fp, run_ms=7000.0, cold=True)
+    assert m.predict_run_ms(fp) == pytest.approx(50.0)
+    assert m.snapshot()["cold_observations"] == 2.0
+    # both components survive a journal reload
+    m2 = LatencyModel(path, alpha=0.5)
+    assert m2.predict_run_ms(fp) == pytest.approx(50.0)
+    assert m2.snapshot()["cold_observations"] == 2.0
+
+
+def test_model_loads_pre_cold_journal_lines(tmp_path):
+    """Journals written before the cold component existed (no cold_ms /
+    cold_n keys) load as never-cold instead of being dropped."""
+    import json
+
+    path = str(tmp_path / "legacy.jsonl")
+    fp = "sql:" + "d" * 24
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "fp": fp, "host_ms": 5.0, "device_ms": 20.0,
+            "queue_ms": 2.0, "transfer_ms": 1.0, "run_ms": 26.0,
+            "rows": 1000.0, "n": 3.0}) + "\n")
+    m = LatencyModel(path)
+    assert m.predict_run_ms(fp) == pytest.approx(26.0)
+    assert m.snapshot()["cold_observations"] == 0.0
+
+
 # ---- on/off byte-identity sweep ---------------------------------------------
 
 
